@@ -1,0 +1,44 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Re-implements the capability surface of PaddlePaddle Fluid (reference:
+/root/reference, lzha106/Paddle) with a TPU-first architecture: a
+serializable Program IR built from Python, lowered whole-block to XLA;
+JAX/Pallas kernels; GSPMD/pjit parallelism over device meshes; stateless
+PRNG; orbax-style sharded checkpointing. See SURVEY.md for the layer map.
+"""
+
+__version__ = "0.1.0"
+
+from paddle_tpu import (  # noqa: F401
+    backward,
+    clip,
+    compiler,
+    executor,
+    framework,
+    initializer,
+    layers,
+    optimizer,
+    regularizer,
+    unique_name,
+)
+from paddle_tpu.backward import append_backward, gradients  # noqa: F401
+from paddle_tpu.compiler import (  # noqa: F401
+    BuildStrategy,
+    CompiledProgram,
+    ExecutionStrategy,
+)
+from paddle_tpu.executor import Executor, Scope, global_scope  # noqa: F401
+from paddle_tpu.framework import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Program,
+    TPUPlace,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    name_scope,
+    program_guard,
+)
+from paddle_tpu.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+
+# `fluid`-style one-stop namespace: `import paddle_tpu as fluid` largely works.

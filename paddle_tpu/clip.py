@@ -1,0 +1,101 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+class BaseGradientClipAttr:
+    def process(self, params_grads):
+        raise NotImplementedError
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def process(self, params_grads):
+        from paddle_tpu.layers import nn
+
+        return [
+            (p, nn.clip(g, self.min, self.max) if g is not None else None)
+            for p, g in params_grads
+        ]
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def process(self, params_grads):
+        from paddle_tpu.layers import nn
+
+        return [
+            (p, nn.clip_by_norm(g, self.clip_norm) if g is not None else None)
+            for p, g in params_grads
+        ]
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def process(self, params_grads):
+        from paddle_tpu.layer_helper import LayerHelper
+        from paddle_tpu.layers import nn, tensor
+
+        helper = LayerHelper("global_norm_clip")
+        sq_norms = []
+        for _, g in params_grads:
+            if g is None:
+                continue
+            out = helper.create_variable_for_type_inference(dtype=g.dtype)
+            helper.append_op("squared_l2_norm", inputs={"X": g},
+                             outputs={"Out": out})
+            sq_norms.append(out)
+        if not sq_norms:
+            return params_grads
+        total = nn.sums(sq_norms)
+        global_norm = nn.sqrt(total)
+        clip_v = tensor.fill_constant([1], "float32", self.clip_norm)
+        scale = nn.elementwise_div(
+            clip_v, nn.elementwise_max(global_norm, clip_v)
+        )
+        return [
+            (p, nn.elementwise_mul(g, scale) if g is not None else None)
+            for p, g in params_grads
+        ]
+
+
+class ErrorClipByValue:
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+
+_clip_attr: Optional[BaseGradientClipAttr] = None
+_clip_param_names: Optional[set] = None
+
+
+def set_gradient_clip(clip: BaseGradientClipAttr, param_list=None, program=None):
+    """Install a gradient clip. ``param_list`` (names or Variables) restricts
+    clipping to those parameters; None clips all."""
+    global _clip_attr, _clip_param_names
+    _clip_attr = clip
+    if param_list is None:
+        _clip_param_names = None
+    else:
+        _clip_param_names = {
+            p if isinstance(p, str) else p.name for p in param_list
+        }
+
+
+def append_gradient_clip_ops(params_grads):
+    if _clip_attr is None:
+        return params_grads
+    if _clip_param_names is None:
+        return _clip_attr.process(params_grads)
+    selected = [(p, g) for p, g in params_grads if p.name in _clip_param_names]
+    untouched = [(p, g) for p, g in params_grads if p.name not in _clip_param_names]
+    return _clip_attr.process(selected) + untouched

@@ -1,0 +1,98 @@
+"""CompiledProgram: SPMD parallel execution strategies.
+
+The reference implements data parallelism by graph rewriting — cloning ops
+per device and inserting per-gradient NCCL allreduce op handles (reference:
+python/paddle/fluid/compiler.py:118, framework/parallel_executor.cc:284,
+ir/multi_devices_graph_pass/multi_devices_graph_pass.cc:208-247). On TPU the
+idiomatic equivalent is GSPMD: mark the batch inputs as sharded over a device
+mesh axis, keep parameters replicated, and let XLA insert the grad
+all-reduce over ICI during SPMD partitioning. One program, one compile, any
+number of devices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.framework import Program
+
+
+class BuildStrategy:
+    """Structured build config (reference: details/build_strategy.h:57-93).
+    Most knobs are XLA's job now; kept for API parity and for the ones that
+    still matter (sharding axes)."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = None
+        self.memory_optimize = True   # XLA buffer assignment
+        self.enable_inplace = True    # XLA donation
+        self.fuse_all_reduce_ops = True  # XLA allreduce combiner
+
+
+class ExecutionStrategy:
+    """(reference: details/execution_strategy.h) — retained for API parity."""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+
+
+class CompiledProgram:
+    """Wraps a Program with a parallel execution plan
+    (reference: compiler.py:49)."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        self._mesh: Optional[Mesh] = None
+        self._data_parallel = False
+        self.build_strategy: Optional[BuildStrategy] = None
+        self.exec_strategy: Optional[ExecutionStrategy] = None
+        self._loss_name: Optional[str] = None
+
+    def with_data_parallel(
+        self,
+        loss_name: Optional[str] = None,
+        build_strategy: Optional[BuildStrategy] = None,
+        exec_strategy: Optional[ExecutionStrategy] = None,
+        share_vars_from=None,
+        places=None,
+        devices=None,
+    ) -> "CompiledProgram":
+        """Data-parallel over all visible devices (or ``devices``)."""
+        self._data_parallel = True
+        self._loss_name = loss_name
+        self.build_strategy = build_strategy or BuildStrategy()
+        self.exec_strategy = exec_strategy or ExecutionStrategy()
+        devs = devices if devices is not None else jax.devices()
+        self._mesh = Mesh(np.asarray(devs), ("data",))
+        return self
+
+    @property
+    def mesh(self) -> Optional[Mesh]:
+        return self._mesh
+
+    # --- executor hooks ---
+
+    def shardings(self, lowered):
+        """(in_shardings, out_shardings) pytree prefixes for jit."""
+        if not self._data_parallel or self._mesh is None:
+            return None, None
+        repl = NamedSharding(self._mesh, P())
+        batch = NamedSharding(self._mesh, P("data"))
+        # fn(state, feeds, key) -> (fetches, new_state)
+        in_shardings = (repl, batch, repl)
+        out_shardings = (repl, repl)
+        return in_shardings, out_shardings
+
+    def shard_inputs(self, state, feeds):
+        """Pre-place inputs; jit's in_shardings handles the real placement."""
+        return state, feeds

@@ -1,0 +1,101 @@
+"""Auto-derived gradient kernels.
+
+The reference hand-writes a C++ grad kernel and a GradOpDescMaker per op
+(reference: framework/grad_op_desc_maker.h; e.g. operators/mul_op.cc). Here a
+``<type>_grad`` kernel is derived mechanically from the forward JAX kernel
+with ``jax.vjp``: the grad op re-traces the forward inside the same XLA
+computation, XLA CSEs the duplicated forward work, and rematerialization
+policy is left to the compiler (HBM-friendly; see SURVEY.md section 7).
+
+Grad op desc convention (produced by backward.append_backward):
+- inputs:  every forward input slot (same slot names), every forward output
+  slot, plus ``GRAD::<out_slot>`` slots holding output gradients.
+- outputs: ``GRAD::<in_slot>`` slots holding input gradients, aligned
+  positionally with the forward input slot; "" marks a hole (no grad needed).
+- attrs:   forward attrs + ``fwd_input_slots``/``fwd_output_slots`` +
+  ``forward_op_idx`` (so stochastic ops replay the same PRNG key).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import OpDef
+
+GRAD_SLOT_PREFIX = "GRAD::"
+_GRAD_META_ATTRS = ("fwd_input_slots", "fwd_output_slots", "forward_op_idx")
+
+
+def _floatp(x) -> bool:
+    try:
+        return jnp.issubdtype(jnp.result_type(x), jnp.floating)
+    except Exception:
+        return False
+
+
+def make_grad_compute(fwd: OpDef):
+    """Build the compute fn for the auto grad op of ``fwd``."""
+
+    def grad_compute(ins: Dict[str, List[Any]], attrs: Dict[str, Any], rng=None):
+        in_slots = list(attrs["fwd_input_slots"])
+        out_slots = list(attrs["fwd_output_slots"])
+        fwd_attrs = {k: v for k, v in attrs.items() if k not in _GRAD_META_ATTRS}
+        rng_kwargs = {"rng": rng} if fwd.needs_rng else {}
+
+        fwd_ins = {s: list(ins.get(s, [])) for s in in_slots}
+
+        # Which (slot, position) entries are differentiable.
+        diff_keys: List[tuple] = []
+        for s in in_slots:
+            if fwd.diff_inputs is not None and s not in fwd.diff_inputs:
+                continue
+            for i, x in enumerate(fwd_ins[s]):
+                if x is not None and _floatp(x):
+                    diff_keys.append((s, i))
+
+        # Probe the forward once for output slot arity (traced; XLA CSEs it).
+        probe = fwd.compute({s: list(v) for s, v in fwd_ins.items()},
+                            fwd_attrs, **rng_kwargs)
+        arity = {o: len(probe.get(o, [])) for o in out_slots}
+
+        def fwd_fn(diff_vals):
+            merged = {s: list(v) for s, v in fwd_ins.items()}
+            for (s, i), v in zip(diff_keys, diff_vals):
+                merged[s][i] = v
+            outs = fwd.compute(merged, fwd_attrs, **rng_kwargs)
+            return [y for o in out_slots for y in outs.get(o, [])]
+
+        primals = [fwd_ins[s][i] for (s, i) in diff_keys]
+        out_flat, vjp_fn = jax.vjp(fwd_fn, primals)
+
+        # Cotangents aligned with out_flat; zeros where the program did not
+        # provide a gradient for an output.
+        cotangents = []
+        k = 0
+        for o in out_slots:
+            gslot = ins.get(GRAD_SLOT_PREFIX + o, [])
+            for i in range(arity[o]):
+                y = out_flat[k]
+                g = gslot[i] if i < len(gslot) else None
+                if g is None:
+                    g = jnp.zeros(jnp.shape(y), jnp.result_type(y))
+                else:
+                    g = jnp.asarray(g, jnp.result_type(y))
+                    if jnp.shape(g) != jnp.shape(y):
+                        g = jnp.broadcast_to(g, jnp.shape(y))
+                cotangents.append(g)
+                k += 1
+
+        (grads,) = vjp_fn(cotangents)
+
+        outs: Dict[str, List[Any]] = {}
+        for (s, i), g in zip(diff_keys, grads):
+            lst = outs.setdefault(GRAD_SLOT_PREFIX + s, [None] * len(fwd_ins[s]))
+            lst[i] = g
+        return outs
+
+    grad_compute.__name__ = f"{fwd.type}_grad_compute"
+    return grad_compute

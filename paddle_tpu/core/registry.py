@@ -1,0 +1,129 @@
+"""Operator registry.
+
+The TPU-native analog of the reference's op registry
+(reference: paddle/fluid/framework/op_registry.h:197-240 and
+framework/op_info.h). Differences by design:
+
+- A kernel here is a pure JAX function over ``jax.numpy`` arrays, traced and
+  fused by XLA, instead of a (place, dtype, layout, library)-dispatched C++
+  kernel (reference: framework/operator.cc:881-964). Kernel selection,
+  layout/dtype transform (reference: framework/data_transform.cc) and device
+  placement all collapse into XLA compilation.
+- Gradient kernels are not hand-written. Every op gets an auto-derived
+  ``<type>_grad`` kernel built from ``jax.vjp`` of its forward compute
+  (replacing the per-op GradOpDescMaker machinery, reference:
+  framework/grad_op_desc_maker.h). Ops with non-default gradient structure
+  (e.g. dropout reusing its mask) may register a custom grad maker.
+- Shape inference (reference: framework/shape_inference.h) is abstract
+  evaluation: ``jax.eval_shape`` over the same compute function.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+# Slot-keyed values: {"X": [arr, ...], "Y": [arr]}
+Ins = Dict[str, List[Any]]
+Outs = Dict[str, List[Any]]
+ComputeFn = Callable[..., Outs]  # compute(ins, attrs, rng=None) -> outs
+
+GRAD_SUFFIX = "@GRAD"
+GRAD_OP_SUFFIX = "_grad"
+
+
+@dataclasses.dataclass
+class OpDef:
+    """Definition of one operator type."""
+
+    type: str
+    compute: ComputeFn
+    # Slots that hold differentiable (float) inputs. None = all float inputs.
+    diff_inputs: Optional[Sequence[str]] = None
+    # Custom grad maker: fn(op: Operator, block) -> list of op-desc dicts.
+    # None = auto vjp-based grad.
+    grad_maker: Optional[Callable] = None
+    # True if this op has no gradient (e.g. metrics, fill ops).
+    no_grad: bool = False
+    # True if compute wants an `rng` keyword (PRNG key).
+    needs_rng: bool = False
+    # Persistable state the op updates in place, as {output_slot: input_slot}
+    # name-aliasing pairs (e.g. batch_norm MeanOut <- Mean).
+    inplace: Optional[Dict[str, str]] = None
+    # Python-level metadata for program printing.
+    doc: str = ""
+
+    def __post_init__(self):
+        if self.diff_inputs is not None:
+            self.diff_inputs = tuple(self.diff_inputs)
+
+
+_OP_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(
+    type: str,
+    *,
+    diff_inputs: Optional[Sequence[str]] = None,
+    grad_maker: Optional[Callable] = None,
+    no_grad: bool = False,
+    needs_rng: bool = False,
+    inplace: Optional[Dict[str, str]] = None,
+    doc: str = "",
+) -> Callable[[ComputeFn], ComputeFn]:
+    """Decorator registering ``fn`` as the kernel for op ``type``."""
+
+    def deco(fn: ComputeFn) -> ComputeFn:
+        if type in _OP_REGISTRY:
+            raise ValueError(f"op '{type}' registered twice")
+        _OP_REGISTRY[type] = OpDef(
+            type=type,
+            compute=fn,
+            diff_inputs=diff_inputs,
+            grad_maker=grad_maker,
+            no_grad=no_grad,
+            needs_rng=needs_rng,
+            inplace=inplace,
+            doc=doc or (fn.__doc__ or ""),
+        )
+        return fn
+
+    return deco
+
+
+def get_op_def(type: str) -> OpDef:
+    _ensure_ops_loaded()
+    try:
+        return _OP_REGISTRY[type]
+    except KeyError:
+        raise KeyError(
+            f"operator '{type}' is not registered; known ops: "
+            f"{sorted(_OP_REGISTRY)[:40]}..."
+        ) from None
+
+
+def has_op(type: str) -> bool:
+    _ensure_ops_loaded()
+    return type in _OP_REGISTRY
+
+
+def registered_ops() -> List[str]:
+    _ensure_ops_loaded()
+    return sorted(_OP_REGISTRY)
+
+
+_ops_loaded = False
+
+
+def _ensure_ops_loaded():
+    # Lazy import to break the registry <-> ops module cycle.
+    global _ops_loaded
+    if not _ops_loaded:
+        _ops_loaded = True
+        try:
+            from paddle_tpu import ops  # noqa: F401  (registers everything)
+        except Exception:
+            # Re-surface the real import error on the next call instead of
+            # reporting an empty registry forever.
+            _ops_loaded = False
+            raise

@@ -1,0 +1,76 @@
+"""DataFeeder: sample lists -> feed dict of dense numpy batches
+(reference: python/paddle/fluid/data_feeder.py).
+
+LoD conversion is replaced by pad-to-bucket: variable-length sequence fields
+are padded to the batch max (or a fixed bucket) and a companion ``<name>_len``
+int array carries true lengths (SURVEY.md section 5 static-shape discipline).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.framework import Variable
+
+
+class DataFeeder:
+    """``pad_to`` declares ragged fields: {var_name: bucket_len}. A declared
+    field is ALWAYS padded/truncated to its bucket and always emits a
+    companion ``<name>_len`` int64 array — fixed shapes (one XLA compile),
+    no batch-dependent feed signature."""
+
+    def __init__(
+        self,
+        feed_list: Sequence[Variable],
+        place=None,
+        program=None,
+        pad_to: Optional[Dict[str, int]] = None,
+    ):
+        self.feed_vars = list(feed_list)
+        self.place = place
+        self.pad_to = dict(pad_to or {})
+
+    def feed(self, iterable) -> Dict[str, np.ndarray]:
+        """iterable: list of samples; each sample is a tuple aligned with
+        feed_list. Returns {name: batched ndarray} (+ ``name_len`` for fields
+        declared in ``pad_to``)."""
+        columns: List[List] = [[] for _ in self.feed_vars]
+        for sample in iterable:
+            if len(sample) != len(self.feed_vars):
+                raise ValueError(
+                    f"sample has {len(sample)} fields, expected "
+                    f"{len(self.feed_vars)}"
+                )
+            for c, v in zip(columns, sample):
+                c.append(np.asarray(v))
+        out: Dict[str, np.ndarray] = {}
+        for var, col in zip(self.feed_vars, columns):
+            if var.name in self.pad_to:
+                bucket = self.pad_to[var.name]
+                tail = col[0].shape[1:]
+                batch = np.zeros((len(col), bucket) + tail, dtype=col[0].dtype)
+                lengths = np.zeros((len(col),), dtype=np.int64)
+                for i, a in enumerate(col):
+                    n = min(a.shape[0], bucket)
+                    batch[i, :n] = a[:n]
+                    lengths[i] = n
+                out[var.name + "_len"] = lengths
+            else:
+                shapes = {a.shape for a in col}
+                if len(shapes) != 1:
+                    raise ValueError(
+                        f"feed field '{var.name}' is ragged {sorted(shapes)[:3]}; "
+                        f"declare it in DataFeeder(pad_to={{'{var.name}': L}}) "
+                        f"to pad to a fixed bucket (XLA needs static shapes)"
+                    )
+                batch = np.stack(col)
+            dtype = np.dtype(var.dtype) if var.dtype else batch.dtype
+            if batch.dtype != dtype:
+                batch = batch.astype(dtype)
+            want = var.shape
+            if want is not None and len(want) == batch.ndim + 1 and want[-1] == 1:
+                batch = batch[..., None]  # label column convention [N] -> [N,1]
+            out[var.name] = batch
+        return out

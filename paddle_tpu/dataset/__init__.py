@@ -1,0 +1,16 @@
+"""Dataset loaders (reference: python/paddle/dataset/).
+
+The reference auto-downloads mnist/cifar/imdb/wmt16/... In this environment
+there is no egress, so each dataset has a deterministic synthetic generator
+with the exact shapes/vocabulary of the real one (same reader contract), and
+an optional ``data_dir`` to load real files when present. Benchmarks are
+throughput-oriented, so synthetic data measures the same compute.
+"""
+
+from paddle_tpu.dataset import (  # noqa: F401
+    cifar,
+    imdb,
+    mnist,
+    uci_housing,
+    wmt16,
+)

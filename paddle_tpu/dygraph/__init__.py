@@ -1,0 +1,1 @@
+from paddle_tpu.dygraph import base  # noqa: F401

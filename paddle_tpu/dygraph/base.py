@@ -1,0 +1,20 @@
+"""Dygraph (eager) mode base (reference: python/paddle/fluid/dygraph/base.py:29)."""
+
+import contextlib
+
+_in_dygraph = False
+
+
+def _in_dygraph_mode() -> bool:
+    return _in_dygraph
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    global _in_dygraph
+    old = _in_dygraph
+    _in_dygraph = True
+    try:
+        yield
+    finally:
+        _in_dygraph = old

@@ -1,0 +1,162 @@
+"""Executor and Scope.
+
+API parity with the reference's ``fluid.Executor`` (reference:
+python/paddle/fluid/executor.py:550) but execution is whole-block XLA
+compilation (see core/lowering.py) instead of injecting feed/fetch ops and
+interpreting. The compiled-function cache keyed on
+(program version, feed signature, fetch list) replaces the reference's
+prepared-context cache (reference: executor.py:704).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import lowering
+from paddle_tpu.framework import (
+    CPUPlace,
+    Program,
+    TPUPlace,
+    Variable,
+    default_main_program,
+)
+
+
+class Scope:
+    """name -> device array container (reference: framework/scope.h:45).
+
+    Values live as committed JAX arrays (device-resident between steps); numpy
+    values are accepted and converted lazily.
+    """
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def set(self, name: str, value):
+        self._vars[name] = value
+
+    def find_var(self, name: str):
+        return self._vars.get(name)
+
+    def var_names(self) -> List[str]:
+        return list(self._vars)
+
+    def has(self, name: str) -> bool:
+        return name in self._vars
+
+    def drop(self, name: str):
+        self._vars.pop(name, None)
+
+    def clear(self):
+        self._vars.clear()
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class Executor:
+    """Runs programs. ``place`` selects the default JAX device kind."""
+
+    def __init__(self, place: Optional[Union[CPUPlace, TPUPlace]] = None):
+        self.place = place if place is not None else TPUPlace(0)
+        self._cache: Dict[tuple, Any] = {}
+        self._step = 0
+
+    # --- public API ---
+
+    def run(
+        self,
+        program=None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+    ):
+        from paddle_tpu.compiler import CompiledProgram
+
+        compiled = None
+        if isinstance(program, CompiledProgram):
+            compiled = program
+            program = compiled.program
+        if program is None:
+            program = default_main_program()
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        fetch_names = [
+            f.name if isinstance(f, Variable) else str(f) for f in fetch_list
+        ]
+
+        feed_items = sorted(feed.items())
+        feed_names = [k for k, _ in feed_items]
+        feed_vals = {}
+        for k, v in feed_items:
+            arr = np.asarray(v) if not isinstance(v, jax.Array) else v
+            feed_vals[k] = arr
+
+        sig = tuple(
+            (k, tuple(np.shape(v)), str(jnp.result_type(v))) for k, v in feed_vals.items()
+        )
+        key = (
+            id(program),
+            program.version,
+            id(compiled) if compiled is not None else 0,
+            sig,
+            tuple(fetch_names),
+            id(scope),
+        )
+        entry = self._cache.get(key) if use_program_cache else None
+        if entry is None:
+            entry = self._compile(program, compiled, feed_names, fetch_names, scope)
+            if use_program_cache:
+                self._cache[key] = entry
+        fn, lowered = entry
+
+        state = {}
+        for n in lowered.state_in_names:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable '{n}' used by the program is not initialized in "
+                    f"the scope — run the startup program first"
+                )
+            state[n] = v
+
+        seed = program.random_seed if program.random_seed is not None else 0
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
+        self._step += 1
+
+        if compiled is not None:
+            state, feed_vals = compiled.shard_inputs(state, feed_vals)
+
+        fetches, new_state = fn(state, feed_vals, rng)
+        for n, v in new_state.items():
+            scope.set(n, v)
+
+        if return_numpy:
+            fetches = [np.asarray(x) for x in fetches]
+        return fetches
+
+    def close(self):
+        self._cache.clear()
+
+    # --- internals ---
+
+    def _compile(self, program, compiled, feed_names, fetch_names, scope):
+        lowered = lowering.lower_block(program, 0, feed_names, fetch_names)
+        in_shardings = out_shardings = None
+        if compiled is not None:
+            in_shardings, out_shardings = compiled.shardings(lowered)
+        fn = lowering.jit_lowered(
+            lowered, in_shardings=in_shardings, out_shardings=out_shardings
+        )
+        return fn, lowered

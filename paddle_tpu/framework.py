@@ -1,0 +1,631 @@
+"""Program IR: Program / Block / Operator / Variable / Parameter.
+
+TPU-native re-design of the reference's Python graph builder
+(reference: python/paddle/fluid/framework.py:366,925,1370,2705,3481).
+The programming model is the same define-then-run contract — Python appends
+OpDescs into blocks of a serializable Program — but:
+
+- Shape/dtype inference is abstract evaluation of the registered JAX kernel
+  (``jax.eval_shape``) instead of per-op C++ InferShape.
+- There is no LoD; variable-length data is padded/bucketed host-side and
+  carried as dense tensors plus masks (XLA static-shape discipline,
+  SURVEY.md section 5).
+- Execution happens by lowering a whole block to one XLA computation
+  (see core/lowering.py), so the Program is a *staging* IR, not an
+  interpreter instruction list.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from paddle_tpu import unique_name
+from paddle_tpu.core.registry import GRAD_SUFFIX, get_op_def, has_op
+from paddle_tpu.proto import framework_pb2 as pb
+
+# Sentinel used to stand in for a symbolic (-1) batch dim during abstract
+# shape inference. Prime and unlikely to appear as a real static dim.
+_BATCH_SENTINEL = 997
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+def convert_np_dtype_to_dtype_(dtype) -> str:
+    """Canonicalize any dtype spec to a numpy dtype name string."""
+    if isinstance(dtype, str) and dtype in ("bfloat16",):
+        return "bfloat16"
+    try:
+        return np.dtype(dtype).name
+    except TypeError:
+        # jax dtypes like jnp.bfloat16
+        return np.dtype(getattr(dtype, "dtype", dtype)).name
+
+
+class Variable:
+    """A named tensor in a Block (reference: framework.py:366)."""
+
+    def __init__(
+        self,
+        block: "Block",
+        name: str,
+        shape: Optional[Sequence[int]] = None,
+        dtype: Any = "float32",
+        persistable: bool = False,
+        stop_gradient: bool = False,
+        is_parameter: bool = False,
+        trainable: bool = True,
+        kind: int = pb.VarDesc.DENSE_TENSOR,
+    ):
+        self.block = block
+        self.name = name
+        self.shape = tuple(int(d) for d in shape) if shape is not None else None
+        self.dtype = convert_np_dtype_to_dtype_(dtype) if dtype is not None else None
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_parameter = is_parameter
+        self.trainable = trainable
+        self.kind = kind
+        # set by layers that carry a sequence mask alongside padded data
+        self.mask_name: Optional[str] = None
+
+    @property
+    def grad_name(self) -> str:
+        return grad_var_name(self.name)
+
+    def to_proto(self) -> pb.VarDesc:
+        d = pb.VarDesc(name=self.name, kind=self.kind)
+        if self.dtype is not None:
+            d.dtype = self.dtype
+        if self.shape is not None:
+            d.shape.extend(self.shape)
+        d.persistable = self.persistable
+        d.stop_gradient = self.stop_gradient
+        d.is_parameter = self.is_parameter
+        d.trainable = self.trainable
+        return d
+
+    def __repr__(self):
+        return (
+            f"Var({self.name}, shape={self.shape}, dtype={self.dtype}"
+            + (", persistable" if self.persistable else "")
+            + (", stop_gradient" if self.stop_gradient else "")
+            + ")"
+        )
+
+    __str__ = __repr__
+
+    # numpy-style conveniences used by model code
+    @property
+    def ndim(self):
+        return len(self.shape) if self.shape is not None else None
+
+    def astype(self, dtype):
+        from paddle_tpu import layers
+
+        return layers.cast(self, dtype)
+
+    def _binary(self, other, op, reverse=False):
+        from paddle_tpu import layers
+
+        if not isinstance(other, Variable):
+            other = layers.fill_constant(
+                shape=[1], dtype=self.dtype, value=float(other)
+            )
+        a, b = (other, self) if reverse else (self, other)
+        return layers.elementwise_op(op, a, b)
+
+    def __add__(self, o):
+        return self._binary(o, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._binary(o, "elementwise_sub", reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, "elementwise_div")
+
+    def __rtruediv__(self, o):
+        return self._binary(o, "elementwise_div", reverse=True)
+
+    def __neg__(self):
+        from paddle_tpu import layers
+
+        return layers.scale(self, scale=-1.0)
+
+
+class Parameter(Variable):
+    """A trainable persistable variable (reference: framework.py:3481)."""
+
+    def __init__(self, block, name, shape, dtype, **kwargs):
+        self.initializer = kwargs.pop("initializer", None)
+        self.regularizer = kwargs.pop("regularizer", None)
+        self.optimize_attr = kwargs.pop("optimize_attr", {"learning_rate": 1.0})
+        self.do_model_average = kwargs.pop("do_model_average", None)
+        trainable = kwargs.pop("trainable", True)
+        super().__init__(
+            block,
+            name,
+            shape=shape,
+            dtype=dtype,
+            persistable=True,
+            stop_gradient=not trainable,
+            is_parameter=True,
+            trainable=trainable,
+            **kwargs,
+        )
+
+
+class Operator:
+    """One op invocation: type + slot-keyed inputs/outputs + attrs
+    (reference: framework.py:925)."""
+
+    def __init__(
+        self,
+        block: "Block",
+        type: str,
+        inputs: Optional[Dict[str, Any]] = None,
+        outputs: Optional[Dict[str, Any]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        self.block = block
+        self.type = type
+        self.inputs: Dict[str, List[str]] = _normalize_slots(inputs)
+        self.outputs: Dict[str, List[str]] = _normalize_slots(outputs)
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+
+    def input(self, slot: str) -> List[str]:
+        return self.inputs.get(slot, [])
+
+    def output(self, slot: str) -> List[str]:
+        return self.outputs.get(slot, [])
+
+    @property
+    def input_arg_names(self) -> List[str]:
+        return [n for ns in self.inputs.values() for n in ns]
+
+    @property
+    def output_arg_names(self) -> List[str]:
+        return [n for ns in self.outputs.values() for n in ns]
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+    def _set_attr(self, name: str, val):
+        self.attrs[name] = val
+
+    def to_proto(self) -> pb.OpDesc:
+        d = pb.OpDesc(type=self.type)
+        for slot, names in self.inputs.items():
+            v = d.inputs.add()
+            v.parameter = slot
+            v.arguments.extend(names)
+        for slot, names in self.outputs.items():
+            v = d.outputs.add()
+            v.parameter = slot
+            v.arguments.extend(names)
+        for k, val in self.attrs.items():
+            a = d.attrs.add()
+            a.name = k
+            _attr_to_proto(a, val)
+        return d
+
+    def __repr__(self):
+        ins = ", ".join(f"{s}={n}" for s, n in self.inputs.items())
+        outs = ", ".join(f"{s}={n}" for s, n in self.outputs.items())
+        return f"{{{outs}}} = {self.type}({ins}) attrs={self.attrs}"
+
+
+def _normalize_slots(slots) -> Dict[str, List[str]]:
+    out: Dict[str, List[str]] = {}
+    for slot, v in (slots or {}).items():
+        if v is None:
+            continue
+        if isinstance(v, (Variable, str)):
+            v = [v]
+        names = [x.name if isinstance(x, Variable) else str(x) for x in v]
+        if names:
+            out[slot] = names
+    return out
+
+
+def _attr_to_proto(a: pb.OpDesc.Attr, val):
+    if isinstance(val, bool):
+        a.type, a.b = pb.BOOLEAN, val
+    elif isinstance(val, int):
+        a.type, a.l = pb.LONG, val
+    elif isinstance(val, float):
+        a.type, a.float64 = pb.FLOAT64, val
+    elif isinstance(val, str):
+        a.type, a.s = pb.STRING, val
+    elif isinstance(val, Block):
+        a.type, a.block_idx = pb.BLOCK, val.idx
+    elif isinstance(val, (list, tuple)):
+        if all(isinstance(x, bool) for x in val) and val:
+            a.type = pb.BOOLEANS
+            a.bools.extend(val)
+        elif all(isinstance(x, int) for x in val):
+            a.type = pb.LONGS
+            a.longs.extend(val)
+        elif all(isinstance(x, float) for x in val):
+            a.type = pb.FLOATS
+            a.floats.extend(float(x) for x in val)
+        elif all(isinstance(x, str) for x in val):
+            a.type = pb.STRINGS
+            a.strings.extend(val)
+        elif all(isinstance(x, Block) for x in val):
+            a.type = pb.BLOCKS
+            a.blocks_idx.extend(b.idx for b in val)
+        else:
+            raise TypeError(f"unsupported list attr {val!r}")
+    else:
+        raise TypeError(f"unsupported attr {val!r} ({type(val)})")
+
+
+def _attr_from_proto(a: pb.OpDesc.Attr, program: "Program"):
+    t = a.type
+    if t == pb.BOOLEAN:
+        return a.b
+    if t == pb.LONG:
+        return int(a.l)
+    if t == pb.INT:
+        return int(a.i)
+    if t == pb.FLOAT:
+        return float(a.f)
+    if t == pb.FLOAT64:
+        return float(a.float64)
+    if t == pb.STRING:
+        return a.s
+    if t == pb.BLOCK:
+        return program.blocks[a.block_idx]
+    if t == pb.BOOLEANS:
+        return list(a.bools)
+    if t == pb.LONGS:
+        return [int(x) for x in a.longs]
+    if t == pb.INTS:
+        return [int(x) for x in a.ints]
+    if t == pb.FLOATS:
+        return [float(x) for x in a.floats]
+    if t == pb.STRINGS:
+        return list(a.strings)
+    if t == pb.BLOCKS:
+        return [program.blocks[i] for i in a.blocks_idx]
+    raise TypeError(f"unsupported proto attr type {t}")
+
+
+class Block:
+    """An ordered op list + var table (reference: framework.py:1370)."""
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    @property
+    def parent_block(self) -> Optional["Block"]:
+        return None if self.parent_idx < 0 else self.program.blocks[self.parent_idx]
+
+    # --- variables ---
+
+    def create_var(self, name: Optional[str] = None, **kwargs) -> Variable:
+        if name is None:
+            name = unique_name.generate("tmp")
+        if name in self.vars:
+            return self.vars[name]
+        v = Variable(self, name, **kwargs)
+        self.vars[name] = v
+        return v
+
+    def create_parameter(self, name, shape, dtype, **kwargs) -> Parameter:
+        p = Parameter(self, name, shape, dtype, **kwargs)
+        self.vars[name] = p
+        return p
+
+    def var(self, name: str) -> Variable:
+        v = self._find_var_recursive(name)
+        if v is None:
+            raise KeyError(f"variable '{name}' not found in block {self.idx}")
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return self._find_var_recursive(name) is not None
+
+    def _find_var_recursive(self, name: str) -> Optional[Variable]:
+        b: Optional[Block] = self
+        while b is not None:
+            if name in b.vars:
+                return b.vars[name]
+            b = b.parent_block
+        return None
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # --- ops ---
+
+    def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.append(op)
+        self.program._bump_version()
+        self._infer_shapes(op)
+        return op
+
+    def _prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type, inputs, outputs, attrs)
+        self.ops.insert(0, op)
+        self.program._bump_version()
+        self._infer_shapes(op)
+        return op
+
+    def _infer_shapes(self, op: Operator):
+        """Abstract-eval the kernel to fill output var shapes/dtypes."""
+        if not has_op(op.type):
+            return
+        opdef = get_op_def(op.type)
+        try:
+            import jax
+
+            ins = {}
+            for slot, names in op.inputs.items():
+                specs = []
+                for n in names:
+                    v = self._find_var_recursive(n)
+                    if v is None or v.shape is None or v.dtype is None:
+                        return  # cannot infer without input metadata
+                    shape = tuple(
+                        _BATCH_SENTINEL if d == -1 else d for d in v.shape
+                    )
+                    specs.append(jax.ShapeDtypeStruct(shape, np.dtype(v.dtype)))
+                ins[slot] = specs
+
+            kwargs = {}
+            if opdef.needs_rng:
+                kwargs["rng"] = jax.random.PRNGKey(0)
+
+            outs = jax.eval_shape(
+                lambda i: opdef.compute(i, dict(op.attrs), **kwargs), ins
+            )
+            for slot, names in op.outputs.items():
+                results = outs.get(slot, [])
+                for n, r in zip(names, results):
+                    if r is None:
+                        continue
+                    v = self._find_var_recursive(n)
+                    if v is None:
+                        v = self.create_var(name=n)
+                    shape = tuple(
+                        -1 if d == _BATCH_SENTINEL else d for d in r.shape
+                    )
+                    v.shape = shape
+                    v.dtype = np.dtype(r.dtype).name
+        except Exception:
+            # Shape inference is advisory; lowering uses real shapes.
+            pass
+
+    def to_proto(self) -> pb.BlockDesc:
+        d = pb.BlockDesc(idx=self.idx, parent_idx=self.parent_idx)
+        for v in self.vars.values():
+            d.vars.append(v.to_proto())
+        for op in self.ops:
+            d.ops.append(op.to_proto())
+        return d
+
+    def __repr__(self):
+        lines = [f"block {self.idx} (parent {self.parent_idx}):"]
+        lines += [f"  {v}" for v in self.vars.values()]
+        lines += [f"  {op}" for op in self.ops]
+        return "\n".join(lines)
+
+
+class Program:
+    """A list of blocks; block 0 is global (reference: framework.py:2705)."""
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0, -1)]
+        self.current_block_idx = 0
+        self._version = 0
+        self.random_seed: Optional[int] = None
+        # populated by append_backward: {param_name: grad_name}
+        self._param_grad_map: Dict[str, str] = {}
+
+    def _bump_version(self):
+        self._version += 1
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def current_block(self) -> Block:
+        return self.blocks[self.current_block_idx]
+
+    def _create_block(self, parent_idx: Optional[int] = None) -> Block:
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent)
+        self.blocks.append(b)
+        self.current_block_idx = b.idx
+        return b
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    def list_vars(self):
+        for b in self.blocks:
+            yield from b.vars.values()
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for b in self.blocks for v in b.all_parameters()]
+
+    # --- serialization ---
+
+    def to_proto(self) -> pb.ProgramDesc:
+        d = pb.ProgramDesc(version=self._version)
+        if self.random_seed is not None:
+            d.random_seed = self.random_seed
+        for b in self.blocks:
+            d.blocks.append(b.to_proto())
+        return d
+
+    def desc_str(self) -> bytes:
+        return self.to_proto().SerializeToString()
+
+    @staticmethod
+    def from_proto(d: pb.ProgramDesc) -> "Program":
+        p = Program()
+        p.blocks = []
+        for bd in d.blocks:
+            p.blocks.append(Block(p, bd.idx, bd.parent_idx))
+        for bd, b in zip(d.blocks, p.blocks):
+            for vd in bd.vars:
+                shape = tuple(vd.shape) if vd.shape else None
+                kw = dict(
+                    shape=shape,
+                    dtype=vd.dtype or None,
+                    persistable=vd.persistable,
+                    stop_gradient=vd.stop_gradient,
+                    trainable=vd.trainable,
+                    kind=vd.kind,
+                )
+                if vd.is_parameter:
+                    b.create_parameter(
+                        vd.name,
+                        shape,
+                        vd.dtype or "float32",
+                        trainable=vd.trainable,
+                    )
+                else:
+                    b.create_var(name=vd.name, **kw)
+            for od in bd.ops:
+                op = Operator(
+                    b,
+                    od.type,
+                    inputs={v.parameter: list(v.arguments) for v in od.inputs},
+                    outputs={v.parameter: list(v.arguments) for v in od.outputs},
+                    attrs={a.name: _attr_from_proto(a, p) for a in od.attrs},
+                )
+                b.ops.append(op)
+        p._version = d.version
+        if d.HasField("random_seed"):
+            p.random_seed = d.random_seed
+        return p
+
+    @staticmethod
+    def parse_from_string(s: bytes) -> "Program":
+        d = pb.ProgramDesc()
+        d.ParseFromString(s)
+        return Program.from_proto(d)
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program.parse_from_string(self.desc_str())
+        p._param_grad_map = dict(self._param_grad_map)
+        if for_test:
+            for b in p.blocks:
+                for op in b.ops:
+                    if "is_test" in op.attrs:
+                        op.attrs["is_test"] = True
+                    if op.type == "dropout":
+                        op.attrs["is_test"] = True
+                    if op.type == "batch_norm":
+                        op.attrs["is_test"] = True
+        p._bump_version()
+        return p
+
+    def __repr__(self):
+        return "\n".join(repr(b) for b in self.blocks)
+
+    __str__ = __repr__
+
+
+# --- default programs & guards (reference: framework.py:3574-3650) ---
+
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program_
+
+
+def default_startup_program() -> Program:
+    return _startup_program_
+
+
+def switch_main_program(program: Program) -> Program:
+    global _main_program_
+    old, _main_program_ = _main_program_, program
+    return old
+
+
+def switch_startup_program(program: Program) -> Program:
+    global _startup_program_
+    old, _startup_program_ = _startup_program_, program
+    return old
+
+
+class program_guard:
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        self.old_main = switch_main_program(self.main)
+        if self.startup is not None:
+            self.old_startup = switch_startup_program(self.startup)
+        return self
+
+    def __exit__(self, *exc):
+        switch_main_program(self.old_main)
+        if self.startup is not None:
+            switch_startup_program(self.old_startup)
+        return False
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    """Cosmetic name scoping for debugging/profiling."""
+    yield
+
+
+# Simple device "places" for API parity (reference: platform/place.h:79).
+# Actual placement is JAX device assignment; these select default device kind.
+class CPUPlace:
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace:
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+# Alias so reference-style `fluid.CUDAPlace(0)` code keeps working on TPU.
+CUDAPlace = TPUPlace
+
+
+def in_dygraph_mode() -> bool:
+    from paddle_tpu.dygraph import base as dygraph_base
+
+    return dygraph_base._in_dygraph_mode()

@@ -1,0 +1,174 @@
+"""Model I/O: save/load persistables + inference model export.
+
+Reference: python/paddle/fluid/io.py:462,698,903,1083 (save/load_persistables,
+save/load_inference_model) built on save/load ops (operators/save_op.cc).
+TPU-native design: parameters are device arrays in the Scope; persistence is
+host-side numpy .npz (single-file combine) or one file per var, plus the
+serialized ProgramDesc for inference models. Sharded (multi-host) arrays
+gather through jax before serialization; orbax-style async checkpointing
+rides on the same format in parallel/checkpoint.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.executor import Executor, Scope, global_scope
+from paddle_tpu.framework import Program, Variable, default_main_program
+
+_PARAMS_FILE = "__params__.npz"
+_MODEL_FILE = "__model__"
+_META_FILE = "__meta__.json"
+
+
+def _is_persistable(var: Variable) -> bool:
+    return bool(var.persistable)
+
+
+def _collect(program: Program, predicate) -> List[Variable]:
+    return [v for v in program.list_vars() if predicate(v)]
+
+
+def save_vars(
+    executor: Executor,
+    dirname: str,
+    main_program: Optional[Program] = None,
+    vars: Optional[Sequence[Variable]] = None,
+    predicate=None,
+    filename: Optional[str] = None,
+    scope: Optional[Scope] = None,
+):
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = _collect(program, predicate or _is_persistable)
+    os.makedirs(dirname, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        arrays[v.name] = np.asarray(val)
+    if filename is None:
+        filename = _PARAMS_FILE
+    np.savez(os.path.join(dirname, filename), **arrays)
+
+
+def load_vars(
+    executor: Executor,
+    dirname: str,
+    main_program: Optional[Program] = None,
+    vars: Optional[Sequence[Variable]] = None,
+    predicate=None,
+    filename: Optional[str] = None,
+    scope: Optional[Scope] = None,
+):
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    if vars is None:
+        vars = _collect(program, predicate or _is_persistable)
+    if filename is None:
+        filename = _PARAMS_FILE
+    path = os.path.join(dirname, filename)
+    if not path.endswith(".npz") and not os.path.exists(path):
+        path = path + ".npz"
+    with np.load(path) as data:
+        names = set(data.files)
+        for v in vars:
+            if v.name in names:
+                scope.set(v.name, np.asarray(data[v.name]))
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """(reference: io.py:462)"""
+    save_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """(reference: io.py:698)"""
+    load_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(
+        executor, dirname, main_program,
+        predicate=lambda v: getattr(v, "is_parameter", False),
+        filename=filename,
+    )
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(
+        executor, dirname, main_program,
+        predicate=lambda v: getattr(v, "is_parameter", False),
+        filename=filename,
+    )
+
+
+def _prune_for_inference(program: Program, feeded_var_names, target_vars):
+    """Keep only ops needed to compute targets from feeds."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = {v.name if isinstance(v, Variable) else str(v) for v in target_vars}
+    feeds = set(feeded_var_names)
+    keep = []
+    for idx in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[idx]
+        if any(n in needed for n in op.output_arg_names):
+            keep.append(idx)
+            needed.update(n for n in op.input_arg_names if n not in feeds)
+    keep.reverse()
+    block.ops = [block.ops[i] for i in keep]
+    pruned._bump_version()
+    return pruned
+
+
+def save_inference_model(
+    dirname: str,
+    feeded_var_names: Sequence[str],
+    target_vars: Sequence[Variable],
+    executor: Executor,
+    main_program: Optional[Program] = None,
+    model_filename: Optional[str] = None,
+    params_filename: Optional[str] = None,
+    export_for_deployment: bool = True,
+):
+    """(reference: io.py:903) Saves pruned ProgramDesc + params + feed/fetch
+    metadata."""
+    program = main_program or default_main_program()
+    pruned = _prune_for_inference(program, feeded_var_names, target_vars)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "wb") as f:
+        f.write(pruned.desc_str())
+    meta = {
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [
+            v.name if isinstance(v, Variable) else str(v) for v in target_vars
+        ],
+    }
+    with open(os.path.join(dirname, _META_FILE), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, pruned, filename=params_filename)
+    return meta["fetch_names"]
+
+
+def load_inference_model(
+    dirname: str,
+    executor: Executor,
+    model_filename: Optional[str] = None,
+    params_filename: Optional[str] = None,
+):
+    """(reference: io.py:1083) -> (program, feed_names, fetch_vars)."""
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "rb") as f:
+        program = Program.parse_from_string(f.read())
+    with open(os.path.join(dirname, _META_FILE)) as f:
+        meta = json.load(f)
+    load_persistables(executor, dirname, program, filename=params_filename)
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars

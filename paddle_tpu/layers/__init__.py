@@ -1,0 +1,15 @@
+"""Layers API (reference: python/paddle/fluid/layers/)."""
+
+from paddle_tpu.layers.io import *  # noqa: F401,F403
+from paddle_tpu.layers.nn import *  # noqa: F401,F403
+from paddle_tpu.layers.tensor import *  # noqa: F401,F403
+from paddle_tpu.layers import learning_rate_scheduler  # noqa: F401
+from paddle_tpu.layers.learning_rate_scheduler import (  # noqa: F401
+    cosine_decay,
+    exponential_decay,
+    inverse_time_decay,
+    natural_exp_decay,
+    noam_decay,
+    piecewise_decay,
+    polynomial_decay,
+)

@@ -1,0 +1,43 @@
+"""Data layers (reference: python/paddle/fluid/layers/io.py).
+
+``data`` declares a feed variable; there are no feed/fetch *ops* — the
+executor binds feeds directly into the lowered XLA computation
+(core/lowering.py), and device prefetch is the double-buffered host pipeline
+in reader/ (the analog of the reference's buffered_reader.cc).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from paddle_tpu.framework import convert_np_dtype_to_dtype_, default_main_program
+
+__all__ = ["data"]
+
+
+def data(
+    name: str,
+    shape: Sequence[int],
+    append_batch_size: bool = True,
+    dtype: str = "float32",
+    lod_level: int = 0,
+    type=None,
+    stop_gradient: bool = True,
+):
+    """Declare an input variable (reference: layers/io.py data).
+
+    ``lod_level`` is accepted for source compatibility; variable-length data
+    is represented as padded dense + mask/length (SURVEY.md section 5), so it
+    has no effect here.
+    """
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().global_block()
+    return block.create_var(
+        name=name,
+        shape=shape,
+        dtype=convert_np_dtype_to_dtype_(dtype),
+        persistable=False,
+        stop_gradient=stop_gradient,
+    )

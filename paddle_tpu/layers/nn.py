@@ -1,0 +1,1024 @@
+"""NN layer functions (reference: python/paddle/fluid/layers/nn.py — 170
+layer fns). Each builds vars + appends ops via LayerHelper."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from paddle_tpu.framework import Variable
+from paddle_tpu.initializer import ConstantInitializer
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.param_attr import ParamAttr
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv2d_transpose", "pool2d", "batch_norm",
+    "layer_norm", "dropout", "relu", "sigmoid", "tanh", "sqrt", "exp", "log",
+    "abs", "square", "gelu", "leaky_relu", "softplus", "softsign", "elu",
+    "relu6", "swish", "hard_swish", "hard_sigmoid", "softmax", "log_softmax",
+    "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "square_error_cost", "huber_loss",
+    "smooth_l1", "mean", "mul", "matmul", "elementwise_op", "elementwise_add",
+    "elementwise_sub", "elementwise_mul", "elementwise_div", "elementwise_pow",
+    "elementwise_max", "elementwise_min", "reduce_sum", "reduce_mean",
+    "reduce_max", "reduce_min", "reduce_prod", "scale", "cast", "clip",
+    "clip_by_norm", "accuracy", "topk", "one_hot", "lookup_table", "gather",
+    "scatter", "label_smooth", "l2_normalize", "dropout", "split", "pad",
+    "pow", "stack", "unstack", "squeeze", "unsqueeze", "expand", "expand_as",
+    "argmax", "argmin", "equal", "less_than", "greater_than", "logical_and",
+    "logical_or", "logical_not", "where", "cumsum", "increment", "reshape",
+    "transpose", "concat", "fill_constant_like", "log_softmax",
+    "sequence_pool", "sequence_softmax", "sequence_mask", "sequence_reverse",
+    "sequence_expand", "im2sequence", "batch_norm", "group_norm", "prelu",
+    "flatten", "sums", "elementwise_mod", "elementwise_floordiv", "maxout",
+    "mean_iou",
+]
+
+
+def _single_op(op_type, x, attrs=None, dtype=None, slot_in="X", slot_out="Out",
+               name=None, stop_gradient=False):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype or x.dtype, stop_gradient=stop_gradient
+    )
+    helper.append_op(
+        op_type, inputs={slot_in: x}, outputs={slot_out: out}, attrs=attrs or {}
+    )
+    return out
+
+
+# --- dense / conv layers ---
+
+
+def fc(
+    input: Union[Variable, Sequence[Variable]],
+    size: int,
+    num_flatten_dims: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    act: Optional[str] = None,
+    is_test: bool = False,
+    name: Optional[str] = None,
+):
+    """Fully-connected layer (reference: layers/nn.py fc)."""
+    helper = LayerHelper("fc", name=name, bias_attr=bias_attr, act=act)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    param_attrs = param_attr if isinstance(param_attr, (list, tuple)) else [
+        param_attr
+    ] * len(inputs)
+    mul_results = []
+    for x, pa in zip(inputs, param_attrs):
+        import math
+
+        in_features = math.prod(x.shape[num_flatten_dims:])
+        w = helper.create_parameter(
+            ParamAttr._to_attr(pa), shape=[in_features, size], dtype=x.dtype
+        )
+        tmp = helper.create_variable_for_type_inference(dtype=x.dtype)
+        helper.append_op(
+            "mul",
+            inputs={"X": x, "Y": w},
+            outputs={"Out": tmp},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype=inputs[0].dtype)
+        helper.append_op("sum", inputs={"X": mul_results}, outputs={"Out": pre_bias})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(
+    input: Variable,
+    size: Sequence[int],
+    is_sparse: bool = False,
+    is_distributed: bool = False,
+    padding_idx: Optional[int] = None,
+    param_attr=None,
+    dtype: str = "float32",
+    name: Optional[str] = None,
+):
+    """Embedding lookup (reference: layers/nn.py embedding). ``is_sparse`` /
+    ``is_distributed`` are accepted for API parity; on TPU the gradient is a
+    dense XLA scatter-add and sharding is a pjit spec (SURVEY.md section 2.3)."""
+    helper = LayerHelper("embedding", name=name)
+    w = helper.create_parameter(
+        ParamAttr._to_attr(param_attr), shape=list(size), dtype=dtype
+    )
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    attrs = {} if padding_idx is None else {"padding_idx": int(padding_idx)}
+    helper.append_op(
+        "lookup_table",
+        inputs={"W": w, "Ids": input},
+        outputs={"Out": out},
+        attrs=attrs,
+    )
+    return out
+
+
+lookup_table = embedding
+
+
+def conv2d(
+    input: Variable,
+    num_filters: int,
+    filter_size: Union[int, Sequence[int]],
+    stride: Union[int, Sequence[int]] = 1,
+    padding: Union[int, Sequence[int]] = 0,
+    dilation: Union[int, Sequence[int]] = 1,
+    groups: int = 1,
+    param_attr=None,
+    bias_attr=None,
+    use_cudnn: bool = True,
+    act: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """2D convolution, NCHW (reference: layers/nn.py conv2d)."""
+    helper = LayerHelper("conv2d", name=name, bias_attr=bias_attr, act=act)
+    c_in = input.shape[1]
+    fs = list(filter_size) if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+    groups = groups or 1
+    w_shape = [num_filters, c_in // groups] + fs
+
+    import math
+
+    fan_in = (c_in // groups) * math.prod(fs)
+    from paddle_tpu.initializer import NormalInitializer
+
+    default_init = NormalInitializer(0.0, math.sqrt(2.0 / fan_in))
+    w = helper.create_parameter(
+        ParamAttr._to_attr(param_attr),
+        shape=w_shape,
+        dtype=input.dtype,
+        default_initializer=default_init,
+    )
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        "conv2d" if groups == 1 or c_in != groups else "depthwise_conv2d",
+        inputs={"Input": input, "Filter": w},
+        outputs={"Output": out},
+        attrs={
+            "strides": [stride] * 2 if isinstance(stride, int) else list(stride),
+            "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
+            "dilations": [dilation] * 2 if isinstance(dilation, int) else list(dilation),
+            "groups": groups,
+        },
+    )
+    pre_act = _conv_bias(helper, out)
+    return helper.append_activation(pre_act)
+
+
+def _conv_bias(helper, out):
+    bias_attr = helper.kwargs.get("bias_attr")
+    if bias_attr is False:
+        return out
+    num_filters = out.shape[1] if out.shape else 1
+    b = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr), shape=[num_filters], dtype=out.dtype,
+        is_bias=True,
+    )
+    if b is None:
+        return out
+    res = helper.create_variable_for_type_inference(dtype=out.dtype)
+    helper.append_op(
+        "elementwise_add",
+        inputs={"X": out, "Y": b},
+        outputs={"Out": res},
+        attrs={"axis": 1},
+    )
+    return res
+
+
+def conv2d_transpose(
+    input, num_filters, output_size=None, filter_size=None, padding=0,
+    stride=1, dilation=1, groups=1, param_attr=None, bias_attr=None,
+    use_cudnn=True, act=None, name=None,
+):
+    helper = LayerHelper("conv2d_transpose", name=name, bias_attr=bias_attr, act=act)
+    c_in = input.shape[1]
+    fs = list(filter_size) if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+    w = helper.create_parameter(
+        ParamAttr._to_attr(param_attr),
+        shape=[c_in, num_filters // (groups or 1)] + fs,
+        dtype=input.dtype,
+    )
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        "conv2d_transpose",
+        inputs={"Input": input, "Filter": w},
+        outputs={"Output": out},
+        attrs={
+            "strides": [stride] * 2 if isinstance(stride, int) else list(stride),
+            "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
+            "dilations": [dilation] * 2 if isinstance(dilation, int) else list(dilation),
+        },
+    )
+    pre_act = _conv_bias(helper, out)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(
+    input,
+    pool_size=2,
+    pool_type="max",
+    pool_stride=1,
+    pool_padding=0,
+    global_pooling=False,
+    use_cudnn=True,
+    ceil_mode=False,
+    exclusive=True,
+    name=None,
+):
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        "pool2d",
+        inputs={"X": input},
+        outputs={"Out": out},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": [pool_size] * 2 if isinstance(pool_size, int) else list(pool_size),
+            "strides": [pool_stride] * 2 if isinstance(pool_stride, int) else list(pool_stride),
+            "paddings": [pool_padding] * 2 if isinstance(pool_padding, int) else list(pool_padding),
+            "global_pooling": global_pooling,
+            "exclusive": exclusive,
+        },
+    )
+    return out
+
+
+def batch_norm(
+    input,
+    act=None,
+    is_test=False,
+    momentum=0.9,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    data_layout="NCHW",
+    in_place=False,
+    name=None,
+    moving_mean_name=None,
+    moving_variance_name=None,
+    do_model_average_for_mean_and_var=False,
+    use_global_stats=False,
+):
+    """Batch normalization (reference: layers/nn.py batch_norm)."""
+    helper = LayerHelper("batch_norm", name=name, act=act)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    dtype = input.dtype
+
+    scale = helper.create_parameter(
+        ParamAttr._to_attr(param_attr), shape=[c], dtype=dtype,
+        default_initializer=ConstantInitializer(1.0),
+    )
+    bias = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr), shape=[c], dtype=dtype, is_bias=True,
+    )
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, initializer=ConstantInitializer(0.0),
+                  trainable=False),
+        shape=[c], dtype=dtype,
+    )
+    var = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, initializer=ConstantInitializer(1.0),
+                  trainable=False),
+        shape=[c], dtype=dtype,
+    )
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    saved_mean = helper.create_variable_for_type_inference(dtype=dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(dtype=dtype, stop_gradient=True)
+    helper.append_op(
+        "batch_norm",
+        inputs={"X": input, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var},
+        outputs={
+            "Y": out,
+            "MeanOut": mean,
+            "VarianceOut": var,
+            "SavedMean": saved_mean,
+            "SavedVariance": saved_var,
+        },
+        attrs={
+            "momentum": momentum,
+            "epsilon": epsilon,
+            "is_test": is_test or use_global_stats,
+            "data_layout": data_layout,
+        },
+    )
+    return helper.append_activation(out)
+
+
+def layer_norm(
+    input,
+    scale=True,
+    shift=True,
+    begin_norm_axis=1,
+    epsilon=1e-5,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    name=None,
+):
+    helper = LayerHelper("layer_norm", name=name, act=act)
+    import math
+
+    feat = math.prod(input.shape[begin_norm_axis:])
+    inputs = {"X": input}
+    if scale:
+        s = helper.create_parameter(
+            ParamAttr._to_attr(param_attr), shape=[feat], dtype=input.dtype,
+            default_initializer=ConstantInitializer(1.0),
+        )
+        inputs["Scale"] = s
+    if shift:
+        b = helper.create_parameter(
+            ParamAttr._to_attr(bias_attr), shape=[feat], dtype=input.dtype,
+            is_bias=True,
+        )
+        inputs["Bias"] = b
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    m = helper.create_variable_for_type_inference(dtype=input.dtype, stop_gradient=True)
+    v = helper.create_variable_for_type_inference(dtype=input.dtype, stop_gradient=True)
+    helper.append_op(
+        "layer_norm",
+        inputs=inputs,
+        outputs={"Y": out, "Mean": m, "Variance": v},
+        attrs={"begin_norm_axis": begin_norm_axis, "epsilon": epsilon},
+    )
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    # Composed from reshape + layer_norm semantics via primitive ops.
+    raise NotImplementedError("group_norm lands with the vision op pack")
+
+
+def dropout(
+    x,
+    dropout_prob,
+    is_test=False,
+    seed=None,
+    name=None,
+    dropout_implementation="downgrade_in_infer",
+):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    mask = helper.create_variable_for_type_inference(dtype="uint8", stop_gradient=True)
+    helper.append_op(
+        "dropout",
+        inputs={"X": x},
+        outputs={"Out": out, "Mask": mask},
+        attrs={
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            "seed": seed if seed is not None else 0,
+            "dropout_implementation": dropout_implementation,
+        },
+    )
+    return out
+
+
+# --- activations ---
+
+
+def _make_act(name):
+    def _act(x, **kwargs):
+        attrs = {k: v for k, v in kwargs.items() if k != "name"}
+        return _single_op(name, x, attrs=attrs, name=kwargs.get("name"))
+
+    _act.__name__ = name
+    return _act
+
+
+relu = _make_act("relu")
+sigmoid = _make_act("sigmoid")
+tanh = _make_act("tanh")
+sqrt = _make_act("sqrt")
+exp = _make_act("exp")
+log = _make_act("log")
+abs = _make_act("abs")
+square = _make_act("square")
+softplus = _make_act("softplus")
+softsign = _make_act("softsign")
+relu6 = _make_act("relu6")
+swish = _make_act("swish")
+hard_swish = _make_act("hard_swish")
+hard_sigmoid = _make_act("hard_sigmoid")
+elu = _make_act("elu")
+
+
+def gelu(x, approximate=False, name=None):
+    return _single_op("gelu", x, attrs={"approximate": approximate}, name=name)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return _single_op("leaky_relu", x, attrs={"alpha": alpha}, name=name)
+
+
+def pow(x, factor=1.0, name=None):
+    return _single_op("pow", x, attrs={"factor": factor}, name=name)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1]]
+    else:
+        shape = [int(__import__("math").prod(x.shape[1:]))]
+    alpha = helper.create_parameter(
+        ParamAttr._to_attr(param_attr), shape=shape, dtype=x.dtype,
+        default_initializer=ConstantInitializer(0.25),
+    )
+    # prelu(x) = max(0, x) + alpha * min(0, x) composed from primitives
+    pos = relu(x)
+    neg = elementwise_sub(x, pos)
+    scaled = elementwise_mul(neg, alpha, axis=1 if mode == "channel" else -1)
+    return elementwise_add(pos, scaled)
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        "maxout", inputs={"X": x}, outputs={"Out": out}, attrs={"groups": groups}
+    )
+    return out
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    return _single_op("softmax", input, attrs={"axis": axis}, name=name)
+
+
+def log_softmax(input, axis=-1, name=None):
+    return _single_op("log_softmax", input, attrs={"axis": axis}, name=name)
+
+
+# --- losses ---
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        "cross_entropy",
+        inputs={"X": input, "Label": label},
+        outputs={"Y": out},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    return out
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100,
+    numeric_stable_mode=True, return_softmax=False,
+):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax_out = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    loss = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    helper.append_op(
+        "softmax_with_cross_entropy",
+        inputs={"Logits": logits, "Label": label},
+        outputs={"Softmax": softmax_out, "Loss": loss},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        "sigmoid_cross_entropy_with_logits",
+        inputs={"X": x, "Label": label},
+        outputs={"Out": out},
+        attrs={"ignore_index": ignore_index},
+    )
+    return out
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        "square_error_cost",
+        inputs={"X": input, "Label": label},
+        outputs={"Out": out},
+    )
+    return out
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    res = helper.create_variable_for_type_inference(dtype=input.dtype, stop_gradient=True)
+    helper.append_op(
+        "huber_loss",
+        inputs={"X": input, "Y": label},
+        outputs={"Out": out, "Residual": res},
+        attrs={"delta": delta},
+    )
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    diff = helper.create_variable_for_type_inference(dtype=x.dtype, stop_gradient=True)
+    inputs = {"X": x, "Y": y}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = inside_weight
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = outside_weight
+    helper.append_op(
+        "smooth_l1_loss",
+        inputs=inputs,
+        outputs={"Out": out, "Diff": diff},
+        attrs={"sigma": sigma or 1.0},
+    )
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32", name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    inputs = {"X": label}
+    if prior_dist is not None:
+        inputs["PriorDist"] = prior_dist
+    helper.append_op(
+        "label_smooth", inputs=inputs, outputs={"Out": out},
+        attrs={"epsilon": float(epsilon)},
+    )
+    return out
+
+
+# --- math wrappers ---
+
+
+def mean(x, name=None):
+    return _single_op("mean", x, name=name)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        "mul",
+        inputs={"X": x, "Y": y},
+        outputs={"Out": out},
+        attrs={"x_num_col_dims": x_num_col_dims, "y_num_col_dims": y_num_col_dims},
+    )
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        "matmul",
+        inputs={"X": x, "Y": y},
+        outputs={"Out": out},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y,
+               "alpha": float(alpha)},
+    )
+    return out
+
+
+def elementwise_op(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, name=name, act=act)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        op_type, inputs={"X": x, "Y": y}, outputs={"Out": out}, attrs={"axis": axis}
+    )
+    return helper.append_activation(out)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_pow", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_mod", x, y, axis, act, name)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return elementwise_op("elementwise_floordiv", x, y, axis, act, name)
+
+
+def _reduce(op_type, input, dim, keep_dim, name):
+    attrs = {"keep_dim": keep_dim}
+    if dim is None:
+        attrs["reduce_all"] = True
+    else:
+        attrs["dim"] = [dim] if isinstance(dim, int) else list(dim)
+    return _single_op(op_type, input, attrs=attrs, name=name)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_prod", input, dim, keep_dim, name)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", name=name, act=act)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        "scale",
+        inputs={"X": x},
+        outputs={"Out": out},
+        attrs={"scale": float(scale), "bias": float(bias),
+               "bias_after_scale": bias_after_scale},
+    )
+    return helper.append_activation(out)
+
+
+def cast(x, dtype):
+    from paddle_tpu.framework import convert_np_dtype_to_dtype_
+
+    dtype = convert_np_dtype_to_dtype_(dtype)
+    return _single_op("cast", x, attrs={"out_dtype": dtype}, dtype=dtype)
+
+
+def clip(x, min, max, name=None):
+    return _single_op("clip", x, attrs={"min": float(min), "max": float(max)}, name=name)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _single_op("clip_by_norm", x, attrs={"max_norm": float(max_norm)}, name=name)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    sq = square(x)
+    ssum = reduce_sum(sq, dim=axis, keep_dim=True)
+    norm = sqrt(elementwise_max(ssum, fill_constant_like(ssum, epsilon)))
+    return elementwise_div(x, norm)
+
+
+def fill_constant_like(x, value):
+    helper = LayerHelper("fill_any_like")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        "fill_any_like", inputs={"X": x}, outputs={"Out": out},
+        attrs={"value": float(value)},
+    )
+    return out
+
+
+# --- metrics / indexing ---
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    topk_out, topk_indices = topk(input, k)
+    acc = helper.create_variable_for_type_inference(dtype="float32", stop_gradient=True)
+    correct = correct or helper.create_variable_for_type_inference(
+        dtype="int32", stop_gradient=True)
+    total = total or helper.create_variable_for_type_inference(
+        dtype="int32", stop_gradient=True)
+    helper.append_op(
+        "accuracy",
+        inputs={"Out": topk_out, "Indices": topk_indices, "Label": label},
+        outputs={"Accuracy": acc, "Correct": correct, "Total": total},
+    )
+    return acc
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    vals = helper.create_variable_for_type_inference(dtype=input.dtype)
+    idx = helper.create_variable_for_type_inference(dtype="int64", stop_gradient=True)
+    helper.append_op(
+        "top_k", inputs={"X": input}, outputs={"Out": vals, "Indices": idx},
+        attrs={"k": k},
+    )
+    return vals, idx
+
+
+def one_hot(input, depth, dtype="float32"):
+    helper = LayerHelper("one_hot")
+    out = helper.create_variable_for_type_inference(dtype=dtype, stop_gradient=True)
+    helper.append_op(
+        "one_hot", inputs={"X": input}, outputs={"Out": out},
+        attrs={"depth": depth, "dtype": dtype},
+    )
+    return out
+
+
+def argmax(x, axis=0, name=None):
+    return _single_op("arg_max", x, attrs={"axis": axis}, dtype="int64",
+                      stop_gradient=True, name=name)
+
+
+def argmin(x, axis=0, name=None):
+    return _single_op("arg_min", x, attrs={"axis": axis}, dtype="int64",
+                      stop_gradient=True, name=name)
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    out = helper.create_variable_for_type_inference(dtype="float32", stop_gradient=True)
+    helper.append_op(
+        "mean_iou",
+        inputs={"Predictions": input, "Labels": label},
+        outputs={"OutMeanIou": out},
+        attrs={"num_classes": num_classes},
+    )
+    return out
+
+
+def _compare(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    out = cond or helper.create_variable_for_type_inference(
+        dtype="bool", stop_gradient=True)
+    helper.append_op(op_type, inputs={"X": x, "Y": y}, outputs={"Out": out})
+    return out
+
+
+def equal(x, y, cond=None):
+    return _compare("equal", x, y, cond)
+
+
+def less_than(x, y, cond=None, force_cpu=None):
+    return _compare("less_than", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def logical_and(x, y, out=None, name=None):
+    return _compare("logical_and", x, y, out)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _compare("logical_or", x, y, out)
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper("logical_not")
+    out = out or helper.create_variable_for_type_inference(
+        dtype="bool", stop_gradient=True)
+    helper.append_op("logical_not", inputs={"X": x}, outputs={"Out": out})
+    return out
+
+
+def where(condition, x, y, name=None):
+    helper = LayerHelper("where", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        "where", inputs={"Condition": condition, "X": x, "Y": y},
+        outputs={"Out": out},
+    )
+    return out
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False, name=None):
+    return _single_op(
+        "cumsum", x,
+        attrs={"axis": axis, "exclusive": exclusive, "reverse": reverse},
+        name=name,
+    )
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        "increment", inputs={"X": x}, outputs={"Out": out}, attrs={"step": float(value)}
+    )
+    return out
+
+
+# --- shape manipulation ---
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape2", name=name, act=act)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        "reshape2", inputs={"X": x}, outputs={"Out": out},
+        attrs={"shape": list(shape)},
+    )
+    return helper.append_activation(out)
+
+
+def transpose(x, perm, name=None):
+    return _single_op("transpose2", x, attrs={"axis": list(perm)}, name=name)
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "axis": dim}
+    else:
+        n = len(num_or_sections)
+        attrs = {"sections": list(num_or_sections), "axis": dim}
+    outs = [helper.create_variable_for_type_inference(dtype=input.dtype)
+            for _ in range(n)]
+    helper.append_op("split", inputs={"X": input}, outputs={"Out": outs}, attrs=attrs)
+    return outs
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input[0].dtype)
+    helper.append_op("concat", inputs={"X": list(input)}, outputs={"Out": out},
+                     attrs={"axis": axis})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    out = out or helper.create_variable_for_type_inference(dtype=input[0].dtype)
+    helper.append_op("sum", inputs={"X": list(input)}, outputs={"Out": out})
+    return out
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    out = helper.create_variable_for_type_inference(dtype=x[0].dtype)
+    helper.append_op("stack", inputs={"X": list(x)}, outputs={"Out": out},
+                     attrs={"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack")
+    num = num or x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(dtype=x.dtype)
+            for _ in range(num)]
+    helper.append_op("unstack", inputs={"X": x}, outputs={"Y": outs},
+                     attrs={"axis": axis})
+    return outs
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze2", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("squeeze2", inputs={"X": input}, outputs={"Out": out},
+                     attrs={"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("unsqueeze2", inputs={"X": input}, outputs={"Out": out},
+                     attrs={"axes": list(axes)})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    return _single_op("expand", x, attrs={"expand_times": list(expand_times)}, name=name)
+
+
+def expand_as(x, target_tensor, name=None):
+    helper = LayerHelper("expand_as", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("expand_as", inputs={"X": x, "Y": target_tensor},
+                     outputs={"Out": out})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten2", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("flatten2", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"axis": axis})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _single_op("pad", x, attrs={"paddings": list(paddings),
+                                       "pad_value": float(pad_value)}, name=name)
+
+
+def gather(input, index, overwrite=True):
+    helper = LayerHelper("gather")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op("gather", inputs={"X": input, "Index": index},
+                     outputs={"Out": out})
+    return out
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    helper = LayerHelper("scatter", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        "scatter", inputs={"X": input, "Ids": index, "Updates": updates},
+        outputs={"Out": out}, attrs={"overwrite": overwrite},
+    )
+    return out
+
+
+# --- sequence (padded/masked; see ops/sequence_ops.py) ---
+
+
+def sequence_pool(input, pool_type, length=None):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"X": input}
+    if length is None and getattr(input, "mask_name", None):
+        length = input.block.var(input.mask_name)
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("sequence_pool", inputs=inputs, outputs={"Out": out},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_softmax(input, length=None, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"X": input}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("sequence_softmax", inputs=inputs, outputs={"Out": out})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype=dtype, stop_gradient=True)
+    helper.append_op(
+        "sequence_mask", inputs={"X": x}, outputs={"Y": out},
+        attrs={"maxlen": maxlen if maxlen is not None else -1, "out_dtype": dtype},
+    )
+    return out
+
+
+def sequence_reverse(x, length=None, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {"X": x}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("sequence_reverse", inputs=inputs, outputs={"Y": out})
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op("sequence_expand", inputs={"X": x, "Y": y},
+                     outputs={"Out": out})
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    fs = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+    st = [stride] * 2 if isinstance(stride, int) else list(stride)
+    helper.append_op(
+        "im2sequence", inputs={"X": input}, outputs={"Out": out},
+        attrs={"kernels": fs, "strides": st},
+    )
+    return out

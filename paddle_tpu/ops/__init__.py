@@ -1,0 +1,17 @@
+"""Operator library.
+
+TPU-native kernel set covering the reference's operator library
+(reference: paddle/fluid/operators/ — 415 REGISTER_OPERATOR sites). Every
+kernel is a pure JAX function; XLA fuses, tiles onto the MXU, and schedules.
+Grad kernels are auto-derived (core/autodiff.py) unless an op registers a
+custom grad maker.
+"""
+
+from paddle_tpu.ops import (  # noqa: F401
+    activation_ops,
+    math_ops,
+    nn_ops,
+    optimizer_ops,
+    sequence_ops,
+    tensor_ops,
+)

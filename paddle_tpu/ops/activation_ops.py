@@ -1,0 +1,93 @@
+"""Activation ops (reference: paddle/fluid/operators/activation_op.cc)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _x(ins):
+    return ins["X"][0]
+
+
+def _unary(name, fn):
+    @register_op(name)
+    def _compute(ins, attrs, fn=fn):
+        return {"Out": [fn(_x(ins))]}
+
+
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("tanh", jnp.tanh)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", jax.lax.rsqrt)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("abs", jnp.abs)
+_unary("square", jnp.square)
+_unary("reciprocal", jnp.reciprocal)
+_unary("floor", jnp.floor)
+_unary("ceil", jnp.ceil)
+_unary("round", jnp.round)
+_unary("sin", jnp.sin)
+_unary("cos", jnp.cos)
+_unary("softsign", jax.nn.soft_sign)
+_unary("relu6", lambda x: jnp.clip(x, 0.0, 6.0))
+_unary("silu", jax.nn.silu)
+
+
+@register_op("gelu")
+def _gelu(ins, attrs):
+    approximate = attrs.get("approximate", False)
+    return {"Out": [jax.nn.gelu(_x(ins), approximate=approximate)]}
+
+
+@register_op("leaky_relu")
+def _leaky_relu(ins, attrs):
+    alpha = attrs.get("alpha", 0.02)
+    return {"Out": [jax.nn.leaky_relu(_x(ins), negative_slope=alpha)]}
+
+
+@register_op("softplus")
+def _softplus(ins, attrs):
+    return {"Out": [jax.nn.softplus(_x(ins))]}
+
+
+@register_op("elu")
+def _elu(ins, attrs):
+    return {"Out": [jax.nn.elu(_x(ins), alpha=attrs.get("alpha", 1.0))]}
+
+
+@register_op("pow")
+def _pow(ins, attrs):
+    return {"Out": [jnp.power(_x(ins), attrs.get("factor", 1.0))]}
+
+
+@register_op("hard_sigmoid")
+def _hard_sigmoid(ins, attrs):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return {"Out": [jnp.clip(_x(ins) * slope + offset, 0.0, 1.0)]}
+
+
+@register_op("swish")
+def _swish(ins, attrs):
+    beta = attrs.get("beta", 1.0)
+    x = _x(ins)
+    return {"Out": [x * jax.nn.sigmoid(beta * x)]}
+
+
+@register_op("hard_swish")
+def _hard_swish(ins, attrs):
+    x = _x(ins)
+    threshold = attrs.get("threshold", 6.0)
+    scale = attrs.get("scale", 6.0)
+    offset = attrs.get("offset", 3.0)
+    return {"Out": [x * jnp.clip(x + offset, 0.0, threshold) / scale]}
+
+
+@register_op("logsigmoid")
+def _logsigmoid(ins, attrs):
+    return {"Out": [jax.nn.log_sigmoid(_x(ins))]}

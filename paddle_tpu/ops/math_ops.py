@@ -1,0 +1,212 @@
+"""Dense math ops: elementwise, matmul, reductions, casts.
+
+Reference kernels: paddle/fluid/operators/elementwise/*, mul_op.cc,
+matmul_op.cc, reduce_ops/*, sum_op.cc, cast_op.cc, scale_op.cc, clip_op.cc.
+Broadcasting follows the reference's ``axis`` convention for elementwise ops
+(Y aligned to X starting at ``axis``; -1 = numpy trailing alignment).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    return ins[slot][i]
+
+
+def _bcast_y(x, y, axis: int):
+    """Reshape y per the reference's elementwise axis rule."""
+    if axis is None or axis == -1 or jnp.ndim(y) == jnp.ndim(x):
+        return y
+    ydim = jnp.ndim(y)
+    xdim = jnp.ndim(x)
+    axis = int(axis)
+    new_shape = (1,) * axis + jnp.shape(y) + (1,) * (xdim - axis - ydim)
+    return jnp.reshape(y, new_shape)
+
+
+def _make_elementwise(name, fn):
+    @register_op(name, doc=f"elementwise {name}")
+    def _compute(ins, attrs, name=name, fn=fn):
+        x, y = _x(ins), _x(ins, "Y")
+        y = _bcast_y(x, y, attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+    return _compute
+
+
+_make_elementwise("elementwise_add", jnp.add)
+_make_elementwise("elementwise_sub", jnp.subtract)
+_make_elementwise("elementwise_mul", jnp.multiply)
+_make_elementwise("elementwise_div", jnp.divide)
+_make_elementwise("elementwise_pow", jnp.power)
+_make_elementwise("elementwise_max", jnp.maximum)
+_make_elementwise("elementwise_min", jnp.minimum)
+_make_elementwise("elementwise_floordiv", lambda x, y: jnp.floor_divide(x, y))
+_make_elementwise("elementwise_mod", jnp.mod)
+
+
+def _make_compare(name, fn):
+    @register_op(name, no_grad=True)
+    def _compute(ins, attrs, fn=fn):
+        x, y = _x(ins), _x(ins, "Y")
+        y = _bcast_y(x, y, attrs.get("axis", -1))
+        return {"Out": [fn(x, y)]}
+
+
+_make_compare("equal", jnp.equal)
+_make_compare("not_equal", jnp.not_equal)
+_make_compare("less_than", jnp.less)
+_make_compare("less_equal", jnp.less_equal)
+_make_compare("greater_than", jnp.greater)
+_make_compare("greater_equal", jnp.greater_equal)
+
+
+def _make_logical(name, fn, unary=False):
+    @register_op(name, no_grad=True)
+    def _compute(ins, attrs, fn=fn, unary=unary):
+        if unary:
+            return {"Out": [fn(_x(ins))]}
+        return {"Out": [fn(_x(ins), _x(ins, "Y"))]}
+
+
+_make_logical("logical_and", jnp.logical_and)
+_make_logical("logical_or", jnp.logical_or)
+_make_logical("logical_xor", jnp.logical_xor)
+_make_logical("logical_not", jnp.logical_not, unary=True)
+
+
+@register_op("mul", doc="2D projection matmul with flatten dims (mul_op.cc)")
+def _mul(ins, attrs):
+    x, y = _x(ins), _x(ins, "Y")
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    import math
+
+    xs, ys = jnp.shape(x), jnp.shape(y)
+    x2 = jnp.reshape(x, (math.prod(xs[:xnc]), -1))
+    y2 = jnp.reshape(y, (math.prod(ys[:ync]), -1))
+    out2 = x2 @ y2
+    out_shape = xs[:xnc] + ys[ync:]
+    return {"Out": [jnp.reshape(out2, out_shape)]}
+
+
+@register_op("matmul", doc="batched matmul w/ transpose flags (matmul_op.cc)")
+def _matmul(ins, attrs):
+    x, y = _x(ins), _x(ins, "Y")
+    tx = attrs.get("transpose_X", False)
+    ty = attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if jnp.ndim(x) == 1:
+        x = x[None, :]
+    if jnp.ndim(y) == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+@register_op("sum", doc="add N tensors (sum_op.cc)")
+def _sum(ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("mean", doc="mean over all elements (mean_op.cc)")
+def _mean(ins, attrs):
+    return {"Out": [jnp.mean(_x(ins))]}
+
+
+def _reduce_attrs(x, attrs):
+    if attrs.get("reduce_all", False):
+        dims = None
+    else:
+        dims = attrs.get("dim", [0])
+        if isinstance(dims, int):
+            dims = [dims]
+        dims = tuple(d % jnp.ndim(x) for d in dims)
+    return dims, attrs.get("keep_dim", False)
+
+
+def _make_reduce(name, fn):
+    @register_op(name)
+    def _compute(ins, attrs, fn=fn):
+        x = _x(ins)
+        dims, keep = _reduce_attrs(x, attrs)
+        return {"Out": [fn(x, axis=dims, keepdims=keep)]}
+
+
+_make_reduce("reduce_sum", jnp.sum)
+_make_reduce("reduce_mean", jnp.mean)
+_make_reduce("reduce_max", jnp.max)
+_make_reduce("reduce_min", jnp.min)
+_make_reduce("reduce_prod", jnp.prod)
+
+
+@register_op("cast")
+def _cast(ins, attrs):
+    return {"Out": [_x(ins).astype(attrs["out_dtype"])]}
+
+
+@register_op("scale")
+def _scale(ins, attrs):
+    x = _x(ins)
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * s + b]}
+    return {"Out": [(x + b) * s]}
+
+
+@register_op("clip")
+def _clip(ins, attrs):
+    return {"Out": [jnp.clip(_x(ins), attrs.get("min"), attrs.get("max"))]}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ins, attrs):
+    x = _x(ins)
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": [x * scale]}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ins, attrs):
+    return {"Out": [jnp.sum(jnp.square(_x(ins)))[None]]}
+
+
+@register_op("increment")
+def _increment(ins, attrs):
+    return {"Out": [_x(ins) + attrs.get("step", 1.0)]}
+
+
+@register_op("isfinite", no_grad=True, doc="all-finite check (FLAGS_check_nan_inf analog)")
+def _isfinite(ins, attrs):
+    flags = [jnp.all(jnp.isfinite(x)) for x in ins["X"]]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_and(out, f)
+    return {"Out": [out]}
+
+
+@register_op("p_norm")
+def _p_norm(ins, attrs):
+    x = _x(ins)
+    porder = attrs.get("porder", 2.0)
+    axis = attrs.get("axis", None)
+    keepdim = attrs.get("keepdim", False)
+    out = jnp.sum(jnp.abs(x) ** porder, axis=axis, keepdims=keepdim) ** (1.0 / porder)
+    return {"Out": [out]}

@@ -1,0 +1,361 @@
+"""Neural-network ops: conv, pool, normalization, dropout, losses, metrics.
+
+Reference kernels: paddle/fluid/operators/{conv_op.cc, pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc, softmax_op.cc,
+cross_entropy_op.cc, softmax_with_cross_entropy_op.cc,
+metrics/accuracy_op.cc}. Convs map straight onto the MXU through
+``lax.conv_general_dilated``; XLA picks TPU-friendly layouts regardless of
+the NCHW API convention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    v = ins.get(slot)
+    return v[i] if v else None
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v)
+
+
+@register_op("conv2d", diff_inputs=("Input", "Filter"))
+def _conv2d(ins, attrs):
+    x, w = _x(ins, "Input"), _x(ins, "Filter")
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+@register_op("depthwise_conv2d", diff_inputs=("Input", "Filter"))
+def _depthwise_conv2d(ins, attrs):
+    x, w = _x(ins, "Input"), _x(ins, "Filter")
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", jnp.shape(x)[1])
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+@register_op("conv2d_transpose", diff_inputs=("Input", "Filter"))
+def _conv2d_transpose(ins, attrs):
+    """Gradient-of-conv semantics (reference conv_transpose_op.cc): filter is
+    [C_in, C_out/groups, kh, kw]; out H = (H-1)*s - 2p + d*(k-1) + 1.
+    Expressed as a fractionally-strided forward conv (lhs_dilation) so XLA
+    lowers it onto the MXU like any conv."""
+    x, w = _x(ins, "Input"), _x(ins, "Filter")
+    sh, sw = _pair(attrs.get("strides", [1, 1]))
+    ph, pw = _pair(attrs.get("paddings", [0, 0]))
+    dh, dw = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1)
+    kh, kw = jnp.shape(w)[2], jnp.shape(w)[3]
+    # [C_in, C_out/g, kh, kw] -> flip spatial, swap io -> [C_out, C_in/g, ...]
+    if groups > 1:
+        ci = jnp.shape(w)[0]
+        wg = jnp.reshape(w, (groups, ci // groups) + tuple(jnp.shape(w)[1:]))
+        wg = jnp.flip(wg, axis=(-2, -1))
+        wg = jnp.swapaxes(wg, 1, 2)  # [g, C_out/g, C_in/g, kh, kw]
+        w_eff = jnp.reshape(wg, (-1, ci // groups, kh, kw))
+    else:
+        w_eff = jnp.swapaxes(jnp.flip(w, axis=(-2, -1)), 0, 1)
+    pad_h = dh * (kh - 1) - ph
+    pad_w = dw * (kw - 1) - pw
+    out = jax.lax.conv_general_dilated(
+        x,
+        w_eff,
+        window_strides=(1, 1),
+        padding=[(pad_h, pad_h), (pad_w, pad_w)],
+        lhs_dilation=(sh, sw),
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+@register_op("pool2d")
+def _pool2d(ins, attrs):
+    x = _x(ins)
+    ptype = attrs.get("pooling_type", "max")
+    ksize = _pair(attrs.get("ksize", [2, 2]))
+    strides = _pair(attrs.get("strides", [2, 2]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    if attrs.get("global_pooling", False):
+        ksize = (jnp.shape(x)[2], jnp.shape(x)[3])
+        strides = ksize
+        pads = (0, 0)
+    window = (1, 1) + ksize
+    wstrides = (1, 1) + strides
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, wstrides, padding)
+    else:
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, window, wstrides, padding
+        )
+        if attrs.get("exclusive", True) and pads != (0, 0):
+            ones = jnp.ones_like(x)
+            count = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add, window, wstrides, padding
+            )
+            out = summed / count
+        else:
+            out = summed / (ksize[0] * ksize[1])
+    return {"Out": [out]}
+
+
+@register_op(
+    "batch_norm",
+    diff_inputs=("X", "Scale", "Bias"),
+    inplace={"MeanOut": "Mean", "VarianceOut": "Variance"},
+)
+def _batch_norm(ins, attrs):
+    x = _x(ins)
+    scale, bias = _x(ins, "Scale"), _x(ins, "Bias")
+    mean, var = _x(ins, "Mean"), _x(ins, "Variance")
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False)
+    layout = attrs.get("data_layout", "NCHW")
+    axes = tuple(i for i in range(jnp.ndim(x)) if i != (1 if layout == "NCHW" else jnp.ndim(x) - 1))
+    c_axis = 1 if layout == "NCHW" else jnp.ndim(x) - 1
+    shape = [1] * jnp.ndim(x)
+    shape[c_axis] = jnp.shape(x)[c_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        new_mean, new_var = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        use_mean = jnp.mean(x, axis=axes)
+        use_var = jnp.var(x, axis=axes)
+        new_mean = momentum * mean + (1 - momentum) * use_mean
+        new_var = momentum * var + (1 - momentum) * use_var
+        saved_mean = use_mean
+        saved_var = use_var
+
+    inv = jax.lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(shape)) * inv.reshape(shape) * scale.reshape(
+        shape
+    ) + bias.reshape(shape)
+    return {
+        "Y": [y],
+        "MeanOut": [jax.lax.stop_gradient(new_mean)],
+        "VarianceOut": [jax.lax.stop_gradient(new_var)],
+        "SavedMean": [jax.lax.stop_gradient(saved_mean)],
+        "SavedVariance": [jax.lax.stop_gradient(saved_var)],
+    }
+
+
+@register_op("layer_norm", diff_inputs=("X", "Scale", "Bias"))
+def _layer_norm(ins, attrs):
+    x = _x(ins)
+    scale, bias = _x(ins, "Scale"), _x(ins, "Bias")
+    eps = attrs.get("epsilon", 1e-5)
+    begin = attrs.get("begin_norm_axis", 1)
+    axes = tuple(range(begin, jnp.ndim(x)))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x - mean) * inv
+    feat_shape = jnp.shape(x)[begin:]
+    if scale is not None:
+        y = y * jnp.reshape(scale, (1,) * begin + feat_shape)
+    if bias is not None:
+        y = y + jnp.reshape(bias, (1,) * begin + feat_shape)
+    return {
+        "Y": [y],
+        "Mean": [jax.lax.stop_gradient(jnp.reshape(mean, (-1,)))],
+        "Variance": [jax.lax.stop_gradient(jnp.reshape(var, (-1,)))],
+    }
+
+
+@register_op("dropout", needs_rng=True)
+def _dropout(ins, attrs, rng=None):
+    x = _x(ins)
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        if impl == "upscale_in_train":
+            return {"Out": [x], "Mask": []}
+        return {"Out": [x * (1.0 - p)], "Mask": []}
+    keep = jax.random.bernoulli(rng, 1.0 - p, jnp.shape(x))
+    if impl == "upscale_in_train":
+        y = jnp.where(keep, x / (1.0 - p), 0.0)
+    else:
+        y = jnp.where(keep, x, 0.0)
+    return {"Out": [y], "Mask": [keep.astype(jnp.uint8)]}
+
+
+@register_op("softmax")
+def _softmax(ins, attrs):
+    axis = attrs.get("axis", -1)
+    return {"Out": [jax.nn.softmax(_x(ins), axis=axis)]}
+
+
+@register_op("log_softmax")
+def _log_softmax(ins, attrs):
+    axis = attrs.get("axis", -1)
+    return {"Out": [jax.nn.log_softmax(_x(ins), axis=axis)]}
+
+
+@register_op("cross_entropy", diff_inputs=("X",))
+def _cross_entropy(ins, attrs):
+    x, label = _x(ins), _x(ins, "Label")
+    eps = 1e-8
+    ignore_index = attrs.get("ignore_index", -100)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        if jnp.ndim(label) == jnp.ndim(x):
+            label = jnp.squeeze(label, axis=-1)
+        lbl = label.astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            x, jnp.maximum(lbl, 0)[..., None], axis=-1
+        )
+        loss = -jnp.log(picked + eps)
+        if ignore_index >= 0:
+            keep = (lbl != ignore_index)[..., None]
+            loss = loss * keep.astype(loss.dtype)
+    return {"Y": [loss]}
+
+
+@register_op("softmax_with_cross_entropy", diff_inputs=("Logits",))
+def _softmax_with_cross_entropy(ins, attrs):
+    logits, label = _x(ins, "Logits"), _x(ins, "Label")
+    soft_label = attrs.get("soft_label", False)
+    ignore_index = attrs.get("ignore_index", -100)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lbl = label
+        if jnp.ndim(lbl) == jnp.ndim(logits):
+            lbl = jnp.squeeze(lbl, axis=-1)
+        lbl_i = lbl.astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, jnp.maximum(lbl_i, 0)[..., None], axis=-1)
+        loss = -picked
+        if ignore_index >= 0:
+            mask = (lbl_i != ignore_index)[..., None]
+            loss = loss * mask.astype(loss.dtype)
+    return {"Softmax": [jnp.exp(logp)], "Loss": [loss]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", diff_inputs=("X",))
+def _sigmoid_ce(ins, attrs):
+    x, label = _x(ins), _x(ins, "Label")
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": [loss]}
+
+
+@register_op("huber_loss", diff_inputs=("X",))
+def _huber_loss(ins, attrs):
+    x, y = _x(ins), _x(ins, "Y")
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("square_error_cost", diff_inputs=("X", "Label"))
+def _square_error_cost(ins, attrs):
+    x, label = _x(ins), _x(ins, "Label")
+    return {"Out": [jnp.square(x - label)]}
+
+
+@register_op("smooth_l1_loss", diff_inputs=("X",))
+def _smooth_l1(ins, attrs):
+    x, y = _x(ins), _x(ins, "Y")
+    w_in = _x(ins, "InsideWeight")
+    w_out = _x(ins, "OutsideWeight")
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    if w_in is not None:
+        d = d * w_in
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / s2, 0.5 * d * d * s2, a - 0.5 / s2)
+    if w_out is not None:
+        loss = loss * w_out
+    return {"Out": [jnp.sum(loss, axis=-1, keepdims=True)], "Diff": [d]}
+
+
+@register_op("accuracy", no_grad=True)
+def _accuracy(ins, attrs):
+    indices, label = _x(ins, "Indices"), _x(ins, "Label")
+    if jnp.ndim(label) > 1:
+        label = jnp.squeeze(label, axis=-1)
+    correct = jnp.any(indices == label[:, None], axis=-1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = jnp.asarray(jnp.shape(indices)[0], jnp.float32)
+    return {
+        "Accuracy": [num_correct / total],
+        "Correct": [num_correct.astype(jnp.int32)],
+        "Total": [total.astype(jnp.int32)],
+    }
+
+
+@register_op("mean_iou", no_grad=True)
+def _mean_iou(ins, attrs):
+    pred, label = _x(ins, "Predictions"), _x(ins, "Labels")
+    n = attrs["num_classes"]
+    pred = pred.reshape(-1)
+    label = label.reshape(-1)
+    cm = jnp.zeros((n, n), jnp.float32).at[label, pred].add(1.0)
+    inter = jnp.diag(cm)
+    union = jnp.sum(cm, 0) + jnp.sum(cm, 1) - inter
+    iou = inter / jnp.maximum(union, 1.0)
+    valid = (union > 0).astype(jnp.float32)
+    miou = jnp.sum(iou * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+    return {"OutMeanIou": [miou], "OutWrong": [], "OutCorrect": []}
+
+
+@register_op("maxout", diff_inputs=("X",))
+def _maxout(ins, attrs):
+    x = _x(ins)  # [N, C, H, W]
+    g = attrs["groups"]
+    n, c, h, w = jnp.shape(x)
+    return {"Out": [jnp.max(x.reshape(n, c // g, g, h, w), axis=2)]}
+
+
+@register_op("label_smooth", diff_inputs=("X",))
+def _label_smooth(ins, attrs):
+    x = _x(ins)
+    eps = attrs.get("epsilon", 0.1)
+    k = jnp.shape(x)[-1]
+    dist = ins.get("PriorDist")
+    if dist and dist[0] is not None:
+        return {"Out": [(1 - eps) * x + eps * dist[0]]}
+    return {"Out": [(1 - eps) * x + eps / k]}

@@ -1,0 +1,192 @@
+"""Optimizer update ops.
+
+Reference kernels: paddle/fluid/operators/optimizers/{sgd_op.cc,
+momentum_op.cc, adam_op.cc, adagrad_op.cc, rmsprop_op.cc, lamb_op.cc,
+ftrl_op.cc, lars_momentum_op.cc}. Updates are functional: the op outputs the
+new parameter/accumulator values under the same variable names; the lowering
+rebinds, and XLA's buffer donation makes it in-place in HBM.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _g(ins, slot):
+    v = ins.get(slot)
+    return v[0] if v else None
+
+
+@register_op("sgd", no_grad=True)
+def _sgd(ins, attrs):
+    p, g, lr = _g(ins, "Param"), _g(ins, "Grad"), _g(ins, "LearningRate")
+    return {"ParamOut": [p - lr.reshape(()).astype(p.dtype) * g.astype(p.dtype)]}
+
+
+@register_op("momentum", no_grad=True)
+def _momentum(ins, attrs):
+    p, g, v = _g(ins, "Param"), _g(ins, "Grad"), _g(ins, "Velocity")
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    g = g.astype(p.dtype)
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register_op("lars_momentum", no_grad=True)
+def _lars_momentum(ins, attrs):
+    p, g, v = _g(ins, "Param"), _g(ins, "Grad"), _g(ins, "Velocity")
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    decay = attrs.get("lars_weight_decay", 0.0005)
+    g = g.astype(p.dtype)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+    gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (pn > 0) & (gn > 0), lr * coeff * pn / (gn + decay * pn + 1e-12), lr
+    )
+    v_new = mu * v + local_lr * (g + decay * p)
+    return {"ParamOut": [p - v_new], "VelocityOut": [v_new]}
+
+
+@register_op("adam", no_grad=True)
+def _adam(ins, attrs):
+    p, g = _g(ins, "Param"), _g(ins, "Grad")
+    m1, m2 = _g(ins, "Moment1"), _g(ins, "Moment2")
+    b1p, b2p = _g(ins, "Beta1Pow"), _g(ins, "Beta2Pow")
+    lr = _g(ins, "LearningRate").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    g = g.astype(m1.dtype)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    b1pn, b2pn = b1p * b1, b2p * b2
+    lr_t = lr * jnp.sqrt(1 - b2pn.reshape(())) / (1 - b1pn.reshape(()))
+    upd = lr_t.astype(p.dtype) * (m1n / (jnp.sqrt(m2n) + eps)).astype(p.dtype)
+    return {
+        "ParamOut": [p - upd],
+        "Moment1Out": [m1n],
+        "Moment2Out": [m2n],
+        "Beta1PowOut": [b1pn],
+        "Beta2PowOut": [b2pn],
+    }
+
+
+@register_op("adamw", no_grad=True)
+def _adamw(ins, attrs):
+    p = _g(ins, "Param")
+    wd = attrs.get("weight_decay", 0.01)
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    outs = _adam(ins, attrs)
+    outs["ParamOut"][0] = outs["ParamOut"][0] - lr * wd * p
+    return outs
+
+
+@register_op("adagrad", no_grad=True)
+def _adagrad(ins, attrs):
+    p, g, m = _g(ins, "Param"), _g(ins, "Grad"), _g(ins, "Moment")
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    eps = attrs.get("epsilon", 1e-6)
+    g = g.astype(p.dtype)
+    m_new = m + jnp.square(g)
+    p_new = p - lr * g / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": [p_new], "MomentOut": [m_new]}
+
+
+@register_op("rmsprop", no_grad=True)
+def _rmsprop(ins, attrs):
+    p, g = _g(ins, "Param"), _g(ins, "Grad")
+    ms, mom = _g(ins, "MeanSquare"), _g(ins, "Moment")
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    g = g.astype(p.dtype)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    if attrs.get("centered", False):
+        mg = _g(ins, "MeanGrad")
+        mg_new = rho * mg + (1 - rho) * g
+        denom = ms_new - jnp.square(mg_new) + eps
+        mom_new = mu * mom + lr * g / jnp.sqrt(denom)
+        return {
+            "ParamOut": [p - mom_new],
+            "MeanSquareOut": [ms_new],
+            "MomentOut": [mom_new],
+            "MeanGradOut": [mg_new],
+        }
+    mom_new = mu * mom + lr * g / jnp.sqrt(ms_new + eps)
+    return {
+        "ParamOut": [p - mom_new],
+        "MeanSquareOut": [ms_new],
+        "MomentOut": [mom_new],
+    }
+
+
+@register_op("lamb", no_grad=True)
+def _lamb(ins, attrs):
+    p, g = _g(ins, "Param"), _g(ins, "Grad")
+    m1, m2 = _g(ins, "Moment1"), _g(ins, "Moment2")
+    b1p, b2p = _g(ins, "Beta1Pow"), _g(ins, "Beta2Pow")
+    lr = _g(ins, "LearningRate").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    g = g.astype(m1.dtype)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    mhat = m1n / (1 - b1p.reshape(()))
+    vhat = m2n / (1 - b2p.reshape(()))
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p.astype(m1.dtype)
+    pn = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+    rn = jnp.sqrt(jnp.sum(jnp.square(r.astype(jnp.float32))))
+    trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+    p_new = p - (lr * trust).astype(p.dtype) * r.astype(p.dtype)
+    return {
+        "ParamOut": [p_new],
+        "Moment1Out": [m1n],
+        "Moment2Out": [m2n],
+        "Beta1PowOut": [b1p * b1],
+        "Beta2PowOut": [b2p * b2],
+    }
+
+
+@register_op("ftrl", no_grad=True)
+def _ftrl(ins, attrs):
+    p, g = _g(ins, "Param"), _g(ins, "Grad")
+    sq, lin = _g(ins, "SquaredAccumulator"), _g(ins, "LinearAccumulator")
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    g = g.astype(p.dtype)
+    sq_new = sq + jnp.square(g)
+    sigma = (sq_new**-power - sq**-power) / lr
+    lin_new = lin + g - sigma * p
+    pre = jnp.clip(lin_new, -l1, l1) - lin_new
+    denom = sq_new**-power / lr + 2 * l2
+    p_new = pre / denom
+    return {
+        "ParamOut": [p_new],
+        "SquaredAccumOut": [sq_new],
+        "LinearAccumOut": [lin_new],
+    }
+
+
+@register_op("decayed_adagrad", no_grad=True)
+def _decayed_adagrad(ins, attrs):
+    p, g, m = _g(ins, "Param"), _g(ins, "Grad"), _g(ins, "Moment")
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g = g.astype(p.dtype)
+    m_new = decay * m + (1 - decay) * jnp.square(g)
+    return {"ParamOut": [p - lr * g / (jnp.sqrt(m_new) + eps)], "MomentOut": [m_new]}

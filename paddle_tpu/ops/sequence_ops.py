@@ -1,0 +1,128 @@
+"""Sequence ops over padded/masked dense batches.
+
+The reference's ~30 ``sequence_*`` ops operate on LoD tensors (ragged rows,
+reference: framework/lod_tensor.h:58, operators/sequence_ops/*). XLA needs
+static shapes, so the TPU-native representation is a padded dense batch
+``[B, T, ...]`` plus either an int lengths vector ``[B]`` or a mask
+``[B, T]`` (SURVEY.md section 5, "long-context"). These ops take the padded
+tensor + Length input instead of LoD metadata.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    v = ins.get(slot)
+    return v[i] if v else None
+
+
+def _mask_from(ins, x):
+    """[B, T] float mask from Length input, or all-ones."""
+    length = _x(ins, "Length")
+    t = jnp.shape(x)[1]
+    if length is None:
+        return jnp.ones(jnp.shape(x)[:2], jnp.float32)
+    if jnp.ndim(length) > 1:
+        length = jnp.squeeze(length, axis=-1)
+    return (jnp.arange(t)[None, :] < length[:, None]).astype(jnp.float32)
+
+
+@register_op("sequence_mask", no_grad=True)
+def _sequence_mask(ins, attrs):
+    length = _x(ins)
+    maxlen = attrs.get("maxlen", -1)
+    dtype = attrs.get("out_dtype", "float32")
+    if maxlen < 0:
+        raise ValueError(
+            "sequence_mask on TPU needs a static maxlen (blocks are compiled "
+            "with static shapes); pass maxlen= explicitly"
+        )
+    mask = jnp.arange(maxlen)[None, :] < length[:, None]
+    return {"Y": [mask.astype(dtype)]}
+
+
+@register_op("sequence_pool", diff_inputs=("X",))
+def _sequence_pool(ins, attrs):
+    x = _x(ins)  # [B, T, D]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    mask = _mask_from(ins, x)[..., None].astype(x.dtype)
+    if ptype in ("AVERAGE", "AVG"):
+        s = jnp.sum(x * mask, axis=1)
+        n = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+        out = s / n
+    elif ptype == "SUM":
+        out = jnp.sum(x * mask, axis=1)
+    elif ptype == "SQRT":
+        s = jnp.sum(x * mask, axis=1)
+        n = jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+        out = s / jnp.sqrt(n)
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(mask > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        length = _x(ins, "Length")
+        if length is None:
+            out = x[:, -1]
+        else:
+            if jnp.ndim(length) > 1:
+                length = jnp.squeeze(length, -1)
+            idx = jnp.maximum(length - 1, 0)
+            out = jnp.take_along_axis(
+                x, idx[:, None, None].astype(jnp.int32), axis=1
+            )[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    return {"Out": [out]}
+
+
+@register_op("sequence_softmax", diff_inputs=("X",))
+def _sequence_softmax(ins, attrs):
+    x = _x(ins)  # [B, T]
+    mask = _mask_from(ins, x)
+    neg = jnp.finfo(x.dtype).min
+    z = jnp.where(mask > 0, x, neg)
+    return {"Out": [jax.nn.softmax(z, axis=-1) * mask.astype(x.dtype)]}
+
+
+@register_op("sequence_reverse", diff_inputs=("X",))
+def _sequence_reverse(ins, attrs):
+    x = _x(ins)  # [B, T, ...]
+    length = _x(ins, "Length")
+    t = jnp.shape(x)[1]
+    if length is None:
+        return {"Y": [jnp.flip(x, axis=1)]}
+    if jnp.ndim(length) > 1:
+        length = jnp.squeeze(length, -1)
+    idx = jnp.arange(t)[None, :]
+    rev = jnp.where(idx < length[:, None], length[:, None] - 1 - idx, idx)
+    return {"Y": [jnp.take_along_axis(x, rev.astype(jnp.int32).reshape(rev.shape + (1,) * (jnp.ndim(x) - 2)), axis=1)]}
+
+
+@register_op("sequence_expand", diff_inputs=("X",))
+def _sequence_expand(ins, attrs):
+    # Broadcast per-sequence rows across time: X [B, D] -> [B, T, D].
+    x, y = _x(ins), _x(ins, "Y")
+    t = jnp.shape(y)[1]
+    return {"Out": [jnp.broadcast_to(x[:, None, :], (jnp.shape(x)[0], t, jnp.shape(x)[1]))]}
+
+
+@register_op("im2sequence", diff_inputs=("X",))
+def _im2sequence(ins, attrs):
+    x = _x(ins)  # [N, C, H, W]
+    kernels = attrs.get("kernels", [1, 1])
+    strides = attrs.get("strides", [1, 1])
+    n, c, h, w = jnp.shape(x)
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(kernels), window_strides=tuple(strides),
+        padding="VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [N, C*kh*kw, OH, OW]
+    ph, pw = jnp.shape(patches)[2], jnp.shape(patches)[3]
+    out = jnp.transpose(patches, (0, 2, 3, 1)).reshape(n, ph * pw, -1)
+    return {"Out": [out]}

@@ -1,0 +1,297 @@
+"""Tensor creation & manipulation ops.
+
+Reference kernels: paddle/fluid/operators/{fill_constant_op.cc,
+gaussian_random_op.cc, uniform_random_op.cc, reshape_op.cc, transpose_op.cc,
+concat_op.cc, split_op.cc, slice_op.cc, stack_op.cc, squeeze_op.cc,
+unsqueeze_op.cc, expand_op.cc, gather_op.cc, one_hot_op.cc,
+lookup_table_op.cc, top_k_op.cc, arg_max_op.cc, assign_op.cc}.
+
+RNG ops are stateless-keyed (Philox-style jax PRNG folded per-op and
+per-step by the lowering), replacing the reference's stateful per-op seeds
+(SURVEY.md section 7 hard part 6).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    return ins[slot][i]
+
+
+@register_op("fill_constant", no_grad=True)
+def _fill_constant(ins, attrs):
+    shape = tuple(attrs.get("shape", []))
+    dtype = attrs.get("dtype", "float32")
+    value = attrs.get("value", 0.0)
+    return {"Out": [jnp.full(shape, value, dtype=dtype)]}
+
+
+@register_op("fill_zeros_like", no_grad=True)
+def _fill_zeros_like(ins, attrs):
+    return {"Out": [jnp.zeros_like(_x(ins))]}
+
+
+@register_op("fill_any_like", no_grad=True)
+def _fill_any_like(ins, attrs):
+    return {"Out": [jnp.full_like(_x(ins), attrs.get("value", 0.0))]}
+
+
+@register_op("gaussian_random", no_grad=True, needs_rng=True)
+def _gaussian_random(ins, attrs, rng=None):
+    shape = tuple(attrs["shape"])
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    dtype = attrs.get("dtype", "float32")
+    return {"Out": [mean + std * jax.random.normal(rng, shape, dtype=dtype)]}
+
+
+@register_op("uniform_random", no_grad=True, needs_rng=True)
+def _uniform_random(ins, attrs, rng=None):
+    shape = tuple(attrs["shape"])
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    dtype = attrs.get("dtype", "float32")
+    return {"Out": [jax.random.uniform(rng, shape, dtype=dtype, minval=lo, maxval=hi)]}
+
+
+@register_op("truncated_gaussian_random", no_grad=True, needs_rng=True)
+def _truncated_gaussian_random(ins, attrs, rng=None):
+    shape = tuple(attrs["shape"])
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    dtype = attrs.get("dtype", "float32")
+    x = jax.random.truncated_normal(rng, -2.0, 2.0, shape, dtype=dtype)
+    return {"Out": [mean + std * x]}
+
+
+@register_op("assign")
+def _assign(ins, attrs):
+    return {"Out": [_x(ins)]}
+
+
+@register_op("assign_value", no_grad=True)
+def _assign_value(ins, attrs):
+    shape = tuple(attrs["shape"])
+    dtype = attrs.get("dtype", "float32")
+    vals = np.asarray(attrs["values"], dtype=np.float64)
+    return {"Out": [jnp.asarray(vals.reshape(shape)).astype(dtype)]}
+
+
+@register_op("shape", no_grad=True)
+def _shape(ins, attrs):
+    return {"Out": [jnp.asarray(jnp.shape(_x(ins)), dtype=jnp.int64)]}
+
+
+@register_op("reshape2")
+def _reshape2(ins, attrs):
+    x = _x(ins)
+    # Reference semantics: 0 copies the input dim, -1 infers (reshape_op.cc).
+    shape = [
+        jnp.shape(x)[i] if d == 0 else d for i, d in enumerate(attrs["shape"])
+    ]
+    return {"Out": [jnp.reshape(x, shape)], "XShape": []}
+
+
+@register_op("transpose2")
+def _transpose2(ins, attrs):
+    return {"Out": [jnp.transpose(_x(ins), attrs["axis"])], "XShape": []}
+
+
+@register_op("flatten2")
+def _flatten2(ins, attrs):
+    import math
+
+    x = _x(ins)
+    axis = attrs.get("axis", 1)
+    s = jnp.shape(x)
+    return {
+        "Out": [jnp.reshape(x, (math.prod(s[:axis]) if axis else 1, -1))],
+        "XShape": [],
+    }
+
+
+@register_op("concat")
+def _concat(ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("split")
+def _split(ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, num, axis=axis)
+    return {"Out": list(parts)}
+
+
+@register_op("slice")
+def _slice(ins, attrs):
+    x = _x(ins)
+    axes = attrs["axes"]
+    starts = attrs["starts"]
+    ends = attrs["ends"]
+    idx = [slice(None)] * jnp.ndim(x)
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("stack")
+def _stack(ins, attrs):
+    return {"Out": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack")
+def _unstack(ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", 0)
+    num = jnp.shape(x)[axis]
+    parts = [jnp.squeeze(p, axis=axis) for p in jnp.split(x, num, axis=axis)]
+    return {"Y": parts}
+
+
+@register_op("squeeze2")
+def _squeeze2(ins, attrs):
+    axes = tuple(attrs.get("axes", []))
+    x = _x(ins)
+    return {"Out": [jnp.squeeze(x, axis=axes or None)], "XShape": []}
+
+
+@register_op("unsqueeze2")
+def _unsqueeze2(ins, attrs):
+    x = _x(ins)
+    for ax in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, ax)
+    return {"Out": [x], "XShape": []}
+
+
+@register_op("expand")
+def _expand(ins, attrs):
+    x = _x(ins)
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register_op("expand_as")
+def _expand_as(ins, attrs):
+    x, y = _x(ins), _x(ins, "Y")
+    return {"Out": [jnp.broadcast_to(x, jnp.shape(y))]}
+
+
+@register_op("gather", diff_inputs=("X",))
+def _gather(ins, attrs):
+    x, index = _x(ins), _x(ins, "Index")
+    axis = attrs.get("axis", 0)
+    return {"Out": [jnp.take(x, index, axis=axis)]}
+
+
+@register_op("scatter", diff_inputs=("X", "Updates"))
+def _scatter(ins, attrs):
+    x, ids, updates = _x(ins), _x(ins, "Ids"), _x(ins, "Updates")
+    if attrs.get("overwrite", True):
+        return {"Out": [x.at[ids].set(updates)]}
+    return {"Out": [x.at[ids].add(updates)]}
+
+
+@register_op("one_hot", no_grad=True)
+def _one_hot(ins, attrs):
+    x = _x(ins)
+    depth = attrs["depth"]
+    if jnp.ndim(x) > 1 and jnp.shape(x)[-1] == 1:
+        x = jnp.squeeze(x, axis=-1)
+    return {"Out": [jax.nn.one_hot(x, depth, dtype=attrs.get("dtype", "float32"))]}
+
+
+@register_op("lookup_table", diff_inputs=("W",),
+             doc="embedding lookup; dense scatter-add grad on TPU replaces "
+                 "the reference's SelectedRows sparse grad "
+                 "(lookup_table_op.cc)")
+def _lookup_table(ins, attrs):
+    w, ids = _x(ins, "W"), _x(ins, "Ids")
+    squeeze_last = jnp.ndim(ids) > 1 and jnp.shape(ids)[-1] == 1
+    if squeeze_last:
+        ids = jnp.squeeze(ids, axis=-1)
+    # Reference semantics: kNoPadding when absent; negative = vocab + idx
+    # (lookup_table_op.cc). The layer omits the attr when padding is off.
+    padding_idx = attrs.get("padding_idx", None)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None:
+        if padding_idx < 0:
+            padding_idx = jnp.shape(w)[0] + padding_idx
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return {"Out": [out]}
+
+
+@register_op("top_k", no_grad=True)
+def _top_k(ins, attrs):
+    x = _x(ins)
+    k = attrs["k"]
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("arg_max", no_grad=True)
+def _arg_max(ins, attrs):
+    axis = attrs.get("axis", -1)
+    return {"Out": [jnp.argmax(_x(ins), axis=axis).astype(jnp.int64)]}
+
+
+@register_op("arg_min", no_grad=True)
+def _arg_min(ins, attrs):
+    axis = attrs.get("axis", -1)
+    return {"Out": [jnp.argmin(_x(ins), axis=axis).astype(jnp.int64)]}
+
+
+@register_op("range", no_grad=True)
+def _range(ins, attrs):
+    start = attrs.get("start", 0)
+    end = attrs["end"]
+    step = attrs.get("step", 1)
+    dtype = attrs.get("dtype", "int64")
+    return {"Out": [jnp.arange(start, end, step, dtype=dtype)]}
+
+
+@register_op("where", diff_inputs=("X", "Y"))
+def _where(ins, attrs):
+    cond, x, y = _x(ins, "Condition"), _x(ins), _x(ins, "Y")
+    return {"Out": [jnp.where(cond, x, y)]}
+
+
+@register_op("cumsum")
+def _cumsum(ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis)
+    return {"Out": [out]}
+
+
+@register_op("pad")
+def _pad(ins, attrs):
+    x = _x(ins)
+    paddings = attrs["paddings"]  # [before0, after0, before1, after1, ...]
+    value = attrs.get("pad_value", 0.0)
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(jnp.ndim(x))]
+    return {"Out": [jnp.pad(x, cfg, constant_values=value)]}
+
+
+@register_op("tile")
+def _tile(ins, attrs):
+    return {"Out": [jnp.tile(_x(ins), attrs["repeat_times"])]}

@@ -1,0 +1,448 @@
+"""Optimizers-as-ops (reference: python/paddle/fluid/optimizer.py:50-475).
+
+``minimize`` = append_backward + append optimizer update ops with per-param
+accumulators; the whole update is part of the compiled step function, so XLA
+fuses it with the backward pass (the analog of the reference's fused
+optimizer goal, SURVEY.md section 7 hard part 7).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from paddle_tpu import unique_name
+from paddle_tpu.backward import append_backward
+from paddle_tpu.framework import (
+    Parameter,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+from paddle_tpu.layer_helper import LayerHelper
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._lr_input = learning_rate
+        self._lr_var: Optional[Variable] = None
+        self.regularization = regularization
+        self._name = name
+        # {param_name: {acc_name: Variable}}
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+        self.helper: Optional[LayerHelper] = None
+
+    # --- learning rate ---
+
+    def _create_lr_var(self):
+        if isinstance(self._lr_input, Variable):
+            self._lr_var = self._lr_input
+            return
+        from paddle_tpu.layers import tensor
+
+        self._lr_var = tensor.create_global_var(
+            shape=[1],
+            value=float(self._lr_input),
+            dtype="float32",
+            persistable=True,
+            name=unique_name.generate("learning_rate"),
+        )
+
+    @property
+    def learning_rate(self):
+        return self._lr_var
+
+    def _param_lr(self, param: Parameter):
+        mult = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        if mult == 1.0:
+            return self._lr_var
+        from paddle_tpu.layers import nn
+
+        return nn.scale(self._lr_var, scale=float(mult))
+
+    # --- accumulators ---
+
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None, dtype=None):
+        from paddle_tpu.layers import tensor
+
+        shape = list(shape if shape is not None else param.shape)
+        var = tensor.create_global_var(
+            shape=shape,
+            value=fill_value,
+            dtype=dtype or param.dtype,
+            persistable=True,
+            name=unique_name.generate(f"{param.name}_{name}"),
+        )
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # --- hooks for subclasses ---
+
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, parameters_and_grads):
+        pass
+
+    # --- public API (reference: optimizer.py:352-475) ---
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        """Returns the optimizer update Operators appended to the block."""
+        prog = default_main_program()
+        block = prog.global_block()
+        self._create_lr_var()
+
+        from paddle_tpu import clip as clip_mod
+        from paddle_tpu import regularizer as reg_mod
+
+        params_grads = clip_mod.append_gradient_clip_ops(params_grads)
+        params_grads = reg_mod.append_regularization_ops(
+            params_grads, self.regularization
+        )
+
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        n_before = len(block.ops)
+        for pg in params_grads:
+            self._append_optimize_op(block, pg)
+        self._finish_update(block, params_grads)
+        return block.ops[n_before:]
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        opt_ops = self.apply_gradients(params_grads)
+        return opt_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        block.append_op(
+            "sgd",
+            inputs={"Param": p, "Grad": g, "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        block.append_op(
+            "momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": v,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name, "VelocityOut": v.name},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        block.append_op(
+            "lars_momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": v,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name, "VelocityOut": v.name},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    _op_type = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None, lazy_mode=False):
+        super().__init__(learning_rate, regularization, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow", p, fill_value=1.0, shape=[1])
+            self._add_accumulator("beta2_pow", p, fill_value=1.0, shape=[1])
+
+    def _extra_attrs(self):
+        return {}
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow", p)
+        b2p = self._get_accumulator("beta2_pow", p)
+        block.append_op(
+            self._op_type,
+            inputs={"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+                    "Beta1Pow": b1p, "Beta2Pow": b2p,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name, "Moment1Out": m1.name,
+                     "Moment2Out": m2.name, "Beta1PowOut": b1p.name,
+                     "Beta2PowOut": b2p.name},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon, **self._extra_attrs()},
+        )
+
+
+class AdamWOptimizer(AdamOptimizer):
+    _op_type = "adamw"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, weight_decay=0.01, regularization=None,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, regularization, name)
+        self._weight_decay = weight_decay
+
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
+
+
+class LambOptimizer(AdamOptimizer):
+    _op_type = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, regularization=None,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, regularization, name)
+        self._weight_decay = lamb_weight_decay
+
+    def _extra_attrs(self):
+        return {"weight_decay": self._weight_decay}
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
+                 name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, regularization, name)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        block.append_op(
+            "adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": m,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name, "MomentOut": m.name},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class DecayedAdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": p, "Grad": g, "Moment": m,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name, "MomentOut": m.name},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("moment", p)
+            if self._centered:
+                self._add_accumulator("mean_grad", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        ms = self._get_accumulator("mean_square", p)
+        mom = self._get_accumulator("moment", p)
+        inputs = {"Param": p, "Grad": g, "MeanSquare": ms, "Moment": mom,
+                  "LearningRate": self._param_lr(p)}
+        outputs = {"ParamOut": p.name, "MeanSquareOut": ms.name,
+                   "MomentOut": mom.name}
+        if self._centered:
+            mg = self._get_accumulator("mean_grad", p)
+            inputs["MeanGrad"] = mg
+            outputs["MeanGradOut"] = mg.name
+        block.append_op(
+            "rmsprop", inputs=inputs, outputs=outputs,
+            attrs={"decay": self._rho, "epsilon": self._epsilon,
+                   "momentum": self._momentum, "centered": self._centered},
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._get_accumulator("squared", p)
+        lin = self._get_accumulator("linear", p)
+        block.append_op(
+            "ftrl",
+            inputs={"Param": p, "Grad": g, "SquaredAccumulator": sq,
+                    "LinearAccumulator": lin,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name, "SquaredAccumOut": sq.name,
+                     "LinearAccumOut": lin.name},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+# Short aliases matching the reference's public names.
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+AdamW = AdamWOptimizer
+Adagrad = AdagradOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference: optimizer.py:2292). ``update()`` appends
+    shadow-update ops to the main program; ``apply(executor)``/``restore``
+    swap shadow and live values in the scope for evaluation."""
+
+    def __init__(self, decay=0.999, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._shadows: List[Tuple[Variable, Variable]] = []
+        self._backup: Dict[str, object] = {}
+
+    def update(self):
+        from paddle_tpu.layers import nn, tensor
+
+        prog = default_main_program()
+        for p in prog.all_parameters():
+            if not p.trainable:
+                continue
+            shadow = tensor.create_global_var(
+                shape=list(p.shape), value=0.0, dtype=p.dtype,
+                persistable=True,
+                name=unique_name.generate(f"{self._name}_{p.name}"),
+            )
+            block = prog.global_block()
+            # shadow = decay*shadow + (1-decay)*param
+            scaled = nn.scale(block.var(shadow.name), scale=self._decay)
+            contrib = nn.scale(block.var(p.name), scale=1.0 - self._decay)
+            summed = nn.elementwise_add(scaled, contrib)
+            block.append_op("assign", inputs={"X": summed},
+                            outputs={"Out": shadow.name})
+            self._shadows.append((p, shadow))
+
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap EMA values into the live parameters (scope-level).
+
+        Values are copied to host arrays: Executor runs donate scope buffers
+        to XLA, so aliasing one jax.Array under two scope names (or keeping a
+        reference across a run) would leave dangling device buffers."""
+        import contextlib
+
+        import numpy as np
+
+        from paddle_tpu.executor import global_scope
+
+        scope = global_scope()
+        for p, shadow in self._shadows:
+            if need_restore:
+                self._backup[p.name] = np.asarray(scope.find_var(p.name))
+            sv = scope.find_var(shadow.name)
+            if sv is not None:
+                scope.set(p.name, np.asarray(sv))
+
+        @contextlib.contextmanager
+        def _guard():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return _guard()
+
+    def restore(self, executor=None):
+        from paddle_tpu.executor import global_scope
+
+        scope = global_scope()
+        for name, val in self._backup.items():
+            scope.set(name, val)
+        self._backup.clear()
+
+
+class ModelAverage(Optimizer):
+    """Placeholder for reference optimizer.py:2132; full averaging windows
+    land with the high-level Trainer."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, name)

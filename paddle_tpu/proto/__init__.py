@@ -1,0 +1,2 @@
+from .framework_pb2 import *  # noqa: F401,F403
+from . import framework_pb2  # noqa: F401

@@ -1,0 +1,173 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py:82-360).
+
+A reader is a zero-arg callable returning an iterator of samples. Decorators
+compose: shuffle, buffered (background-thread prefetch), batch, chain,
+compose, map_readers, xmap (multi-thread transform), cache, firstn.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+from typing import Callable, Iterable, List
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for items in zip(*rs):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """(reference: decorator.py:82)"""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, check_alignment: bool = True):
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        iterator = zip(*rs) if check_alignment else itertools.zip_longest(*rs)
+        for outputs in iterator:
+            yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size: int):
+    """Background-thread prefetch (reference: decorator.py buffered) — the
+    host half of double-buffering; device prefetch is reader/pipeline.py."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def worker():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n: int):
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+
+    return data_reader
+
+
+def cache(reader):
+    all_data: List = []
+    filled = [False]
+
+    def data_reader():
+        if not filled[0]:
+            all_data.extend(reader())
+            filled[0] = True
+        yield from all_data
+
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """Multi-thread sample transform (reference: decorator.py xmap_readers).
+    ``order=True`` preserves input order via sequence numbers."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feeder():
+            for i, s in enumerate(reader()):
+                in_q.put((i, s))
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def worker():
+            while True:
+                item = in_q.get()
+                if item is _End:
+                    out_q.put(_End)
+                    break
+                i, s = item
+                out_q.put((i, mapper(s)))
+
+        threading.Thread(target=feeder, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=worker, daemon=True).start()
+
+        ended = 0
+        if not order:
+            while ended < process_num:
+                item = out_q.get()
+                if item is _End:
+                    ended += 1
+                    continue
+                yield item[1]
+            return
+        pending = {}
+        next_idx = 0
+        while ended < process_num or pending:
+            if next_idx in pending:
+                yield pending.pop(next_idx)
+                next_idx += 1
+                continue
+            item = out_q.get()
+            if item is _End:
+                ended += 1
+                continue
+            i, mapped = item
+            pending[i] = mapped
+
+    return data_reader
+
+
+multiprocess_reader = xmap_readers  # thread-based stand-in (no fork on TPU hosts)

@@ -1,0 +1,96 @@
+"""Host->device prefetch pipeline.
+
+The TPU-native analog of the reference's reader-op stack: ``py_reader``
+pushing into a C++ blocking queue plus the double-buffered device prefetch
+(reference: operators/reader/create_py_reader_op.cc, buffered_reader.cc,
+lod_tensor_blocking_queue.h). Here a background thread converts numpy
+batches and issues ``jax.device_put`` ahead of consumption so the chip never
+waits on the host (SURVEY.md section 7 hard part: infeed that doesn't starve
+the chip).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+class DeviceLoader:
+    """Iterate numpy batches with K-deep device-side prefetch."""
+
+    def __init__(self, reader: Callable[[], Iterator], feed_names: Sequence[str],
+                 depth: int = 2, sharding=None):
+        self._reader = reader
+        self._names = list(feed_names)
+        self._depth = depth
+        self._sharding = sharding
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self._depth)
+        END = object()
+
+        def worker():
+            try:
+                for sample in self._reader():
+                    if isinstance(sample, dict):
+                        feed = {
+                            k: jax.device_put(np.asarray(v), self._sharding)
+                            for k, v in sample.items()
+                        }
+                    else:
+                        feed = {
+                            k: jax.device_put(np.asarray(v), self._sharding)
+                            for k, v in zip(self._names, sample)
+                        }
+                    q.put(feed)
+            finally:
+                q.put(END)
+
+        threading.Thread(target=worker, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            yield item
+
+
+class PyReader:
+    """API-compatible stand-in for the reference PyReader
+    (reference: python/paddle/fluid/reader.py:42): decorate with a sample or
+    batch reader, iterate feed dicts."""
+
+    def __init__(self, feed_list=None, capacity: int = 2, use_double_buffer=True,
+                 iterable: bool = True):
+        self._feed_vars = list(feed_list or [])
+        self._capacity = capacity
+        self._batch_reader = None
+        self._places = None
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        self._batch_reader = reader
+        self._places = places
+
+    def decorate_batch_generator(self, reader, places=None):
+        self._batch_reader = reader
+        self._places = places
+
+    def __iter__(self):
+        from paddle_tpu.data_feeder import DataFeeder
+
+        feeder = DataFeeder(self._feed_vars, place=self._places)
+        loader = DeviceLoader(
+            lambda: (feeder.feed(b) for b in self._batch_reader()),
+            [v.name for v in self._feed_vars],
+            depth=self._capacity,
+        )
+        return iter(loader)
+
+    def start(self):
+        pass
+
+    def reset(self):
+        pass

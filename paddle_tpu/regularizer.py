@@ -1,0 +1,50 @@
+"""Weight-decay regularizers (reference: python/paddle/fluid/regularizer.py)."""
+
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        from paddle_tpu.layers import nn
+
+        decay = nn.scale(param, scale=self._coeff)
+        return nn.elementwise_add(grad, decay)
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff: float = 0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        from paddle_tpu.layers import nn
+
+        sign = nn.elementwise_div(
+            param, nn.elementwise_max(nn.abs(param),
+                                      nn.fill_constant_like(param, 1e-12))
+        )
+        decay = nn.scale(sign, scale=self._coeff)
+        return nn.elementwise_add(grad, decay)
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer
+
+
+def append_regularization_ops(params_grads, regularization=None):
+    out = []
+    for p, g in params_grads:
+        reg = getattr(p, "regularizer", None) or regularization
+        if reg is None or g is None:
+            out.append((p, g))
+            continue
+        new_g = reg(p, g, p.block)
+        out.append((p, new_g))
+    return out

@@ -1,0 +1,18 @@
+"""Test env: simulated 8-device CPU mesh.
+
+The TPU analog of the reference's multi-process-on-localhost distributed
+test pattern (reference: tests/unittests/test_dist_base.py:311): sharding
+semantics are validated on a virtual CPU mesh (SURVEY.md section 4
+implication (c)).
+
+Note: the hosted-TPU ("axon") jax plugin overrides the JAX_PLATFORMS env
+var, so platform selection must go through jax.config *after* import but
+before backend initialization.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+# Numeric-gradient checks need f64 reference arithmetic.
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,94 @@
+"""append_backward / gradients structural and numeric checks."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_duplicate_consumer_grads_are_summed():
+    """x feeds two ops -> dx must be the sum of both partials
+    (reference: backward.py:135 _addup_repetitive_outputs_)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(3,), dtype="float32", stop_gradient=False
+        )
+        a = layers.scale(x, scale=2.0)   # da/dx = 2
+        b = layers.scale(x, scale=5.0)   # db/dx = 5
+        s = layers.elementwise_add(a, b)
+        loss = layers.reduce_sum(s)
+        grads = fluid.gradients(loss, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = exe.run(
+        main, feed={"x": np.ones(3, np.float32)}, fetch_list=[grads[0]]
+    )
+    np.testing.assert_allclose(out[0], np.full(3, 7.0), rtol=1e-6)
+    # a sum op must have combined the two partials
+    assert any(op.type == "sum" for op in main.global_block().ops)
+
+
+def test_stop_gradient_blocks_grad():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(3,), dtype="float32", stop_gradient=False
+        )
+        y = main.global_block().create_var(
+            name="y", shape=(3,), dtype="float32", stop_gradient=True
+        )
+        loss = layers.reduce_sum(layers.elementwise_mul(x, y))
+        fluid.append_backward(loss, parameter_list=[])
+    block = main.global_block()
+    assert block.has_var("x@GRAD")
+    assert not block.has_var("y@GRAD")
+
+
+def test_no_grad_set():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(3,), dtype="float32", stop_gradient=False
+        )
+        z = main.global_block().create_var(
+            name="z", shape=(3,), dtype="float32", stop_gradient=False
+        )
+        loss = layers.reduce_sum(layers.elementwise_mul(x, z))
+        fluid.append_backward(loss, parameter_list=[], no_grad_set={"z"})
+    assert main.global_block().has_var("x@GRAD")
+    assert not main.global_block().has_var("z@GRAD")
+
+
+def test_minimize_returns_optimize_ops():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        loss = layers.mean(layers.fc(x, 1))
+        opt_ops, params_grads = fluid.optimizer.SGD(0.1).minimize(loss)
+    from paddle_tpu.framework import Operator, Parameter
+
+    assert opt_ops and all(isinstance(o, Operator) for o in opt_ops)
+    assert all(o.type == "sgd" for o in opt_ops)
+    assert params_grads and all(isinstance(p, Parameter) for p, _ in params_grads)
+
+
+def test_grad_not_flowing_through_int_inputs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = main.global_block().create_var(
+            name="w", shape=(10, 4), dtype="float32", stop_gradient=False
+        )
+        ids = main.global_block().create_var(
+            name="ids", shape=(5, 1), dtype="int64", stop_gradient=True
+        )
+        emb = main.global_block().create_var(name="emb", dtype="float32")
+        main.global_block().append_op(
+            "lookup_table",
+            inputs={"W": w, "Ids": ids},
+            outputs={"Out": emb},
+            attrs={"padding_idx": -1},
+        )
+        loss = layers.reduce_sum(emb)
+        fluid.append_backward(loss, parameter_list=[])
+    assert main.global_block().has_var("w@GRAD")
+    assert not main.global_block().has_var("ids@GRAD")

@@ -1,0 +1,83 @@
+"""Program IR construction, shape inference, serialization round-trip."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build_mlp():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        h = layers.fc(x, 32, act="relu")
+        out = layers.fc(h, 4)
+        loss = layers.mean(layers.softmax(out))
+        drop = layers.dropout(h, 0.5)
+    return main, startup, x, h, out
+
+
+def test_shape_inference():
+    main, startup, x, h, out = _build_mlp()
+    assert x.shape == (-1, 8)
+    assert h.shape == (-1, 32)
+    assert out.shape == (-1, 4)
+
+
+def test_parameters_created():
+    main, startup, *_ = _build_mlp()
+    params = main.all_parameters()
+    names = sorted(p.name for p in params)
+    assert len(params) == 4  # 2x (w, b)
+    shapes = {p.name: p.shape for p in params}
+    assert (8, 32) in shapes.values()
+    assert (32, 4) in shapes.values()
+    # startup program initializes every parameter
+    startup_outs = {
+        n for op in startup.global_block().ops for n in op.output_arg_names
+    }
+    for p in params:
+        assert p.name in startup_outs
+
+
+def test_proto_roundtrip():
+    main, *_ = _build_mlp()
+    s = main.desc_str()
+    clone = fluid.Program.parse_from_string(s)
+    assert len(clone.global_block().ops) == len(main.global_block().ops)
+    assert sorted(clone.global_block().vars) == sorted(main.global_block().vars)
+    for a, b in zip(main.global_block().ops, clone.global_block().ops):
+        assert a.type == b.type
+        assert a.inputs == b.inputs
+        assert a.outputs == b.outputs
+        assert a.attrs == b.attrs
+
+
+def test_clone_for_test_sets_is_test():
+    main, *_ = _build_mlp()
+    test_prog = main.clone(for_test=True)
+    drops = [op for op in test_prog.global_block().ops if op.type == "dropout"]
+    assert drops and all(op.attrs["is_test"] for op in drops)
+    # original untouched
+    drops0 = [op for op in main.global_block().ops if op.type == "dropout"]
+    assert all(not op.attrs.get("is_test") for op in drops0)
+
+
+def test_operator_accessors():
+    main, *_ = _build_mlp()
+    op = main.global_block().ops[0]
+    assert op.type == "mul"
+    assert op.input("X") and op.input("Y")
+    assert op.output("Out")
+
+
+def test_variable_arithmetic_builds_ops():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", shape=[4], dtype="float32")
+        b = layers.data("b", shape=[4], dtype="float32")
+        c = a + b
+        d = c * 2.0
+    types = [op.type for op in main.global_block().ops]
+    assert "elementwise_add" in types
+    assert "elementwise_mul" in types

@@ -1,0 +1,272 @@
+"""Per-op output + numeric-gradient checks through the OpHarness
+(reference test strategy: SURVEY.md section 4 item 1)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpHarness
+
+RS = np.random.RandomState
+
+
+def test_matmul_output_and_grad():
+    x = RS(0).randn(3, 4)
+    y = RS(1).randn(4, 5)
+    h = OpHarness("matmul", {"X": x, "Y": y})
+    h.check_output({"Out": x @ y})
+    h.check_grad(["x_0", "y_0"])
+
+
+def test_matmul_transpose():
+    x = RS(0).randn(4, 3)
+    y = RS(1).randn(5, 4)
+    h = OpHarness("matmul", {"X": x, "Y": y},
+                  attrs={"transpose_X": True, "transpose_Y": True})
+    h.check_output({"Out": x.T @ y.T})
+    h.check_grad(["x_0", "y_0"])
+
+
+def test_matmul_batched():
+    x = RS(0).randn(2, 3, 4)
+    y = RS(1).randn(2, 4, 5)
+    h = OpHarness("matmul", {"X": x, "Y": y})
+    h.check_output({"Out": x @ y})
+    h.check_grad(["x_0", "y_0"])
+
+
+def test_mul_flatten():
+    x = RS(0).randn(2, 3, 4)   # flattened to [2, 12]
+    y = RS(1).randn(12, 5)
+    h = OpHarness("mul", {"X": x, "Y": y}, attrs={"x_num_col_dims": 1})
+    h.check_output({"Out": (x.reshape(2, 12) @ y).reshape(2, 5)})
+    h.check_grad(["x_0", "y_0"])
+
+
+def test_elementwise_add_broadcast_axis():
+    x = RS(0).randn(2, 3, 4)
+    y = RS(1).randn(3)
+    h = OpHarness("elementwise_add", {"X": x, "Y": y}, attrs={"axis": 1})
+    h.check_output({"Out": x + y[None, :, None]})
+    h.check_grad(["x_0", "y_0"])
+
+
+def test_elementwise_div_grad():
+    x = RS(0).randn(3, 4)
+    y = RS(1).randn(3, 4) + 3.0
+    h = OpHarness("elementwise_div", {"X": x, "Y": y})
+    h.check_output({"Out": x / y})
+    h.check_grad(["x_0", "y_0"])
+
+
+def test_softmax():
+    x = RS(0).randn(4, 7)
+    h = OpHarness("softmax", {"X": x})
+    e = np.exp(x - x.max(-1, keepdims=True))
+    h.check_output({"Out": e / e.sum(-1, keepdims=True)})
+    h.check_grad(["x_0"])
+
+
+def test_relu_grad():
+    x = RS(0).randn(4, 5) + 0.1 * np.sign(RS(0).randn(4, 5))
+    x[np.abs(x) < 0.05] = 0.5  # keep away from kink
+    h = OpHarness("relu", {"X": x})
+    h.check_output({"Out": np.maximum(x, 0)})
+    h.check_grad(["x_0"])
+
+
+def test_tanh_sigmoid_grad():
+    x = RS(0).randn(3, 4)
+    OpHarness("tanh", {"X": x}).check_grad(["x_0"])
+    OpHarness("sigmoid", {"X": x}).check_grad(["x_0"])
+
+
+def test_reduce_sum():
+    x = RS(0).randn(3, 4, 5)
+    h = OpHarness("reduce_sum", {"X": x}, attrs={"dim": [1], "keep_dim": True})
+    h.check_output({"Out": x.sum(1, keepdims=True)})
+    h.check_grad(["x_0"])
+
+
+def test_reduce_mean_all():
+    x = RS(0).randn(3, 4)
+    h = OpHarness("reduce_mean", {"X": x}, attrs={"reduce_all": True})
+    h.check_output({"Out": np.asarray(x.mean())})
+    h.check_grad(["x_0"])
+
+
+def test_layer_norm_grad():
+    x = RS(0).randn(4, 6)
+    scale = RS(1).rand(6) + 0.5
+    bias = RS(2).randn(6)
+    h = OpHarness(
+        "layer_norm",
+        {"X": x, "Scale": scale, "Bias": bias},
+        attrs={"begin_norm_axis": 1, "epsilon": 1e-5},
+        out_slots=("Y",),
+    )
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+    h.check_output({"Y": ref}, atol=1e-4)
+    h.check_grad(["x_0", "scale_0", "bias_0"], delta=1e-4)
+
+
+def test_batch_norm_train_grad():
+    x = RS(0).randn(4, 3, 2, 2)
+    scale = RS(1).rand(3) + 0.5
+    bias = RS(2).randn(3)
+    mean = np.zeros(3)
+    var = np.ones(3)
+    h = OpHarness(
+        "batch_norm",
+        {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Variance": var},
+        attrs={"epsilon": 1e-5, "momentum": 0.9, "is_test": False},
+        out_slots=("Y",),
+    )
+    mu = x.mean((0, 2, 3))
+    v = x.var((0, 2, 3))
+    ref = (x - mu[None, :, None, None]) / np.sqrt(v + 1e-5)[None, :, None, None]
+    ref = ref * scale[None, :, None, None] + bias[None, :, None, None]
+    h.check_output({"Y": ref}, atol=1e-4)
+    h.check_grad(["x_0", "scale_0", "bias_0"], delta=1e-4)
+
+
+def test_conv2d_grad():
+    x = RS(0).randn(2, 3, 5, 5)
+    w = RS(1).randn(4, 3, 3, 3)
+    h = OpHarness(
+        "conv2d",
+        {"Input": x, "Filter": w},
+        attrs={"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+               "groups": 1},
+        out_slots=("Output",),
+    )
+    h.check_grad(["input_0", "filter_0"], delta=1e-3, rtol=5e-3)
+
+
+def test_pool2d_avg_grad():
+    x = RS(0).randn(2, 2, 4, 4)
+    h = OpHarness(
+        "pool2d", {"X": x},
+        attrs={"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2],
+               "paddings": [0, 0]},
+    )
+    ref = x.reshape(2, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+    h.check_output({"Out": ref})
+    h.check_grad(["x_0"])
+
+
+def test_pool2d_max():
+    x = RS(0).randn(2, 2, 4, 4)
+    h = OpHarness(
+        "pool2d", {"X": x},
+        attrs={"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2],
+               "paddings": [0, 0]},
+    )
+    ref = x.reshape(2, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    h.check_output({"Out": ref})
+
+
+def test_softmax_with_cross_entropy_grad():
+    logits = RS(0).randn(5, 7)
+    label = RS(1).randint(0, 7, (5, 1)).astype(np.int64)
+    h = OpHarness(
+        "softmax_with_cross_entropy",
+        {"Logits": logits, "Label": label},
+        out_slots=("Loss",),
+    )
+    shifted = logits - logits.max(-1, keepdims=True)
+    logp = shifted - np.log(np.exp(shifted).sum(-1, keepdims=True))
+    ref = -np.take_along_axis(logp, label, axis=-1)
+    h.check_output({"Loss": ref}, atol=1e-5)
+    h.check_grad(["logits_0"])
+
+
+def test_cross_entropy_grad():
+    p = RS(0).rand(4, 5) + 0.1
+    p = p / p.sum(-1, keepdims=True)
+    label = RS(1).randint(0, 5, (4, 1)).astype(np.int64)
+    h = OpHarness("cross_entropy", {"X": p, "Label": label}, out_slots=("Y",))
+    ref = -np.log(np.take_along_axis(p, label, -1) + 1e-8)
+    h.check_output({"Y": ref}, atol=1e-5)
+    h.check_grad(["x_0"])
+
+
+def test_lookup_table_grad():
+    w = RS(0).randn(10, 4)
+    ids = np.array([[1], [3], [3], [7]], dtype=np.int64)
+    h = OpHarness("lookup_table", {"W": w, "Ids": ids})
+    h.check_output({"Out": w[ids[:, 0]]})
+    h.check_grad(["w_0"])
+
+
+def test_gather_grad():
+    x = RS(0).randn(6, 3)
+    idx = np.array([0, 2, 2, 5], dtype=np.int64)
+    h = OpHarness("gather", {"X": x, "Index": idx})
+    h.check_output({"Out": x[idx]})
+    h.check_grad(["x_0"])
+
+
+def test_concat_and_split():
+    a = RS(0).randn(2, 3)
+    b = RS(1).randn(2, 4)
+    h = OpHarness("concat", {"X": [a, b]}, attrs={"axis": 1},
+                  multi_input_slots=("X",))
+    h.check_output({"Out": np.concatenate([a, b], 1)})
+    h.check_grad(["x_0", "x_1"])
+
+
+def test_transpose_reshape_grad():
+    x = RS(0).randn(2, 3, 4)
+    h = OpHarness("transpose2", {"X": x}, attrs={"axis": [2, 0, 1]})
+    h.check_output({"Out": x.transpose(2, 0, 1)})
+    h.check_grad(["x_0"])
+    h2 = OpHarness("reshape2", {"X": x}, attrs={"shape": [2, 12]})
+    h2.check_output({"Out": x.reshape(2, 12)})
+    h2.check_grad(["x_0"])
+
+
+def test_scale_op():
+    x = RS(0).randn(3, 3)
+    h = OpHarness("scale", {"X": x}, attrs={"scale": 2.0, "bias": 1.0})
+    h.check_output({"Out": 2 * x + 1})
+    h.check_grad(["x_0"])
+
+
+def test_sum_op():
+    xs = [RS(i).randn(3, 3) for i in range(3)]
+    h = OpHarness("sum", {"X": xs}, multi_input_slots=("X",))
+    h.check_output({"Out": sum(xs)})
+    h.check_grad(["x_0", "x_1", "x_2"])
+
+
+def test_sequence_pool_masked():
+    x = RS(0).randn(3, 5, 4)
+    length = np.array([2, 5, 3], dtype=np.int64)
+    h = OpHarness("sequence_pool", {"X": x, "Length": length},
+                  attrs={"pooltype": "AVERAGE"})
+    ref = np.stack([x[i, : length[i]].mean(0) for i in range(3)])
+    h.check_output({"Out": ref}, atol=1e-5)
+    h.check_grad(["x_0"])
+
+
+def test_dropout_eval_and_train():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[1000], dtype="float32")
+        out_train = layers.dropout(x, 0.3, dropout_implementation="upscale_in_train")
+        out_eval = layers.dropout(x, 0.3, is_test=True,
+                                  dropout_implementation="upscale_in_train")
+    exe = fluid.Executor(fluid.CPUPlace())
+    xb = np.ones((2, 1000), dtype=np.float32)
+    tr, ev = exe.run(main, feed={"x": xb}, fetch_list=[out_train, out_eval])
+    np.testing.assert_allclose(ev, xb)
+    frac_zero = float((tr == 0).mean())
+    assert 0.2 < frac_zero < 0.4
+    # kept entries upscaled
+    kept = tr[tr != 0]
+    np.testing.assert_allclose(kept, 1.0 / 0.7, rtol=1e-5)

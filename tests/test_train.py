@@ -1,0 +1,93 @@
+"""End-to-end "book" training tests (reference acceptance suite analog:
+tests/book/test_recognize_digits.py — trains to a convergence threshold and
+round-trips save/load_inference_model)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import io, layers, reader
+from paddle_tpu import dataset
+from paddle_tpu.data_feeder import DataFeeder
+
+
+def test_recognize_digits_mlp_converges(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[784], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, 128, act="relu")
+        logits = layers.fc(h, 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(logits, label)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feeder = DataFeeder([img, label])
+
+    losses = []
+    train_reader = reader.batch(dataset.mnist.train(), 64)
+    for epoch in range(3):
+        for batch in train_reader():
+            out = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+            losses.append(float(out[0]))
+
+    accs = []
+    for batch in reader.batch(dataset.mnist.test(), 64)():
+        a = exe.run(test_prog, feed=feeder.feed(batch), fetch_list=[acc])
+        accs.append(float(a[0]))
+    final_acc = float(np.mean(accs))
+    assert losses[-1] < 1.0, f"loss did not converge: {losses[-1]}"
+    assert final_acc > 0.5, f"accuracy too low: {final_acc}"
+
+    # save/load inference model round-trip
+    d = str(tmp_path / "model")
+    io.save_inference_model(d, ["img"], [logits], exe, main)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    prog2, feed_names, fetch_vars = io.load_inference_model(d, exe2)
+    assert feed_names == ["img"]
+    batch = next(reader.batch(dataset.mnist.test(), 8)())
+    fd = feeder.feed(batch)
+    ref = exe.run(test_prog, feed=fd, fetch_list=[logits])[0]
+    got = exe2.run(prog2, feed={"img": fd["img"]}, fetch_list=fetch_vars)[0]
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+
+
+def test_fit_a_line_regression():
+    """reference: tests/book/test_fit_a_line.py — linear regression."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[13], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    feeder = DataFeeder([x, y])
+    losses = []
+    for epoch in range(30):
+        for batch in reader.batch(dataset.uci_housing.train(), 32)():
+            out = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+            losses.append(float(out[0]))
+    assert losses[-1] < 0.1 * losses[0]
+
+
+def test_save_load_persistables(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        h = layers.fc(x, 8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    names = [p.name for p in main.all_parameters()]
+    before = {n: np.array(fluid.global_scope().find_var(n)) for n in names}
+    io.save_persistables(exe, str(tmp_path / "ckpt"), main)
+    for n in names:
+        fluid.global_scope().set(n, np.zeros_like(before[n]))
+    io.load_persistables(exe, str(tmp_path / "ckpt"), main)
+    for n in names:
+        np.testing.assert_array_equal(
+            np.array(fluid.global_scope().find_var(n)), before[n]
+        )
