@@ -1,0 +1,132 @@
+"""Benchmark: Transformer-base training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = achieved model FLOPs utilization / 0.35 (the BASELINE.md
+target: >=35% MFU for Transformer-base on v5e; >1.0 beats the target).
+
+Model: Transformer-base WMT16 config (reference:
+tests/unittests/dist_transformer.py ModelHyperParams — d_model 512,
+d_inner 2048, 6+6 layers, 8 heads), trained with bf16 AMP, full step
+(fwd + autodiff + Adam) as one XLA computation.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+V5E_PEAK_BF16 = 197e12  # FLOP/s per v5e chip
+
+BATCH = 32
+SEQ = 256
+VOCAB = 10000
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def analytic_flops_per_step(cfg, batch, s, t):
+    """Training FLOPs (fwd+bwd) per step: 6*flops_matmul_fwd with attention
+    term; embedding lookups excluded."""
+    d, di, L, h = cfg.d_model, cfg.d_inner, cfg.n_layer, cfg.n_head
+    # per-layer matmul flops (fwd, mults*2):
+    # qkv+out proj: 4 * 2*t*d*d ; ffn: 2 * 2*t*d*di ; attention: 2 * 2*h*t*t*(d/h)
+    def layer_tokens(tok, t_kv):
+        proj = 4 * 2 * tok * d * d
+        ffn = 2 * 2 * tok * d * di
+        attn = 2 * 2 * tok * t_kv * d
+        return proj + ffn + attn
+
+    enc = L * layer_tokens(batch * s, s)
+    # decoder: self attn over t, cross attn over s (extra k/v proj + attn)
+    dec_self = L * layer_tokens(batch * t, t)
+    dec_cross = L * (2 * 2 * batch * t * d * d + 2 * 2 * batch * t * s * d)
+    logits = 2 * batch * t * d * VOCAB
+    fwd = enc + dec_self + dec_cross + logits
+    return 3 * fwd  # bwd ~= 2x fwd
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as T
+
+    backend = jax.default_backend()
+    log(f"backend: {backend}, devices: {jax.devices()}")
+
+    cfg = T.TransformerConfig(
+        src_vocab_size=VOCAB,
+        trg_vocab_size=VOCAB,
+        max_length=SEQ + 2,
+        d_model=512,
+        d_inner=2048,
+        n_head=8,
+        n_layer=6,
+        dropout=0.1,
+    )
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        model = T.build(cfg)
+        fluid.optimizer.Adam(1e-4).minimize(model["loss"])
+    main_prog._amp = True  # bf16 matmuls, f32 master weights
+
+    exe = fluid.Executor()
+    exe.run(startup)
+
+    batch = BATCH
+    while batch >= 4:
+        try:
+            feed = T.make_batch(cfg, batch, SEQ, SEQ, seed=0)
+            t0 = time.time()
+            exe.run(main_prog, feed=feed, fetch_list=[model["loss"]])
+            log(f"compile+first step: {time.time() - t0:.1f}s (batch={batch})")
+            break
+        except Exception as e:
+            # Only resource exhaustion triggers the halved-batch retry; any
+            # other error is a real bug and must surface, not read as perf 0.
+            msg = f"{type(e).__name__}: {e}"
+            if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg:
+                raise
+            log(f"batch {batch} OOM; halving")
+            batch //= 2
+            exe = fluid.Executor()
+            exe.run(startup)
+    else:
+        print(json.dumps({"metric": "transformer_base_train", "value": 0,
+                          "unit": "tokens/sec", "vs_baseline": 0.0}))
+        return
+
+    # steady-state timing
+    feeds = [T.make_batch(cfg, batch, SEQ, SEQ, seed=s) for s in range(4)]
+    for f in feeds[:2]:
+        exe.run(main_prog, feed=f, fetch_list=[model["loss"]])
+    steps = 10
+    t0 = time.time()
+    loss = None
+    for i in range(steps):
+        loss = exe.run(main_prog, feed=feeds[i % 4], fetch_list=[model["loss"]])
+    elapsed = time.time() - t0
+    loss_v = float(loss[0])
+    log(f"{steps} steps in {elapsed:.2f}s, loss={loss_v:.3f}")
+
+    tokens_per_step = batch * SEQ  # target tokens (reference convention)
+    tokens_per_sec = tokens_per_step * steps / elapsed
+    flops = analytic_flops_per_step(cfg, batch, SEQ, SEQ)
+    mfu = (flops * steps / elapsed) / V5E_PEAK_BF16
+    log(f"tokens/sec={tokens_per_sec:.0f}, analytic TFLOP/step={flops/1e12:.2f}, MFU={mfu:.3f}")
+
+    print(json.dumps({
+        "metric": "transformer_base_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.35, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Automatic mixed precision.
+
+Reference: contrib/mixed_precision/decorator.py:190 (fp16 compute + fp32
+master weights + dynamic loss scaling). TPU-native: bf16 on the MXU needs
+no loss scaling, and instead of rewriting the graph with cast ops, the
+lowering applies a dtype policy to the MXU-heavy op set at trace time
+(core/lowering.py AMP_OP_TYPES) — casts fuse into the matmuls, parameters
+stay f32 in HBM.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.framework import default_main_program
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
+             use_dynamic_loss_scaling: bool = False):
+    """Wrap an optimizer so that minimize() marks the program for bf16
+    mixed-precision execution. Loss-scaling args are accepted for API
+    parity; bf16's exponent range makes them no-ops."""
+
+    class _AmpOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, item):
+            return getattr(self._inner, item)
+
+        def minimize(self, loss, **kwargs):
+            result = self._inner.minimize(loss, **kwargs)
+            loss.block.program._amp = True
+            return result
+
+        def backward(self, *args, **kwargs):
+            return self._inner.backward(*args, **kwargs)
+
+        def apply_gradients(self, params_grads):
+            result = self._inner.apply_gradients(params_grads)
+            default_main_program()._amp = True
+            return result
+
+    return _AmpOptimizer(optimizer)
+
+
+def enable_amp(program=None):
+    """Directly mark a program for bf16 execution of MXU-heavy ops."""
+    (program or default_main_program())._amp = True
+
+
+def disable_amp(program=None):
+    (program or default_main_program())._amp = False

@@ -54,6 +54,7 @@ class CompiledProgram:
         self.program = program
         self._mesh: Optional[Mesh] = None
         self._data_parallel = False
+        self._strategy = None  # parallel.DistributedStrategy
         self.build_strategy: Optional[BuildStrategy] = None
         self.exec_strategy: Optional[ExecutionStrategy] = None
         self._loss_name: Optional[str] = None
@@ -76,6 +77,14 @@ class CompiledProgram:
         self._mesh = Mesh(np.asarray(devs), ("data",))
         return self
 
+    def with_strategy(self, strategy) -> "CompiledProgram":
+        """Full SPMD strategy: data axis + per-parameter sharding rules
+        (tensor/expert/sequence parallelism via parallel.DistributedStrategy)."""
+        self._strategy = strategy
+        self._mesh = strategy.mesh
+        self._data_parallel = True
+        return self
+
     @property
     def mesh(self) -> Optional[Mesh]:
         return self._mesh
@@ -83,14 +92,20 @@ class CompiledProgram:
     # --- executor hooks ---
 
     def shardings(self, lowered):
-        """(in_shardings, out_shardings) pytree prefixes for jit."""
+        """(in_shardings, out_shardings) pytrees for jit, aligned with
+        fn(state, feeds, key) -> (fetches, new_state)."""
         if not self._data_parallel or self._mesh is None:
             return None, None
         repl = NamedSharding(self._mesh, P())
-        batch = NamedSharding(self._mesh, P("data"))
-        # fn(state, feeds, key) -> (fetches, new_state)
-        in_shardings = (repl, batch, repl)
-        out_shardings = (repl, repl)
+        if self._strategy is None:
+            batch = NamedSharding(self._mesh, P("data"))
+            return (repl, batch, repl), (repl, repl)
+        st = self._strategy
+        state_in = {n: st.sharding_for(n) for n in lowered.state_in_names}
+        state_out = {n: st.sharding_for(n) for n in lowered.state_out_names}
+        batch = st.batch_sharding()
+        in_shardings = (state_in, batch, st.replicated())
+        out_shardings = (st.replicated(), state_out)
         return in_shardings, out_shardings
 
     def shard_inputs(self, state, feeds):

@@ -31,6 +31,47 @@ from paddle_tpu.framework import Block, Program
 # Ops handled by the lowering itself rather than a registered kernel.
 _STRUCTURAL_OPS = ("feed", "fetch")
 
+# MXU-heavy ops that run in bfloat16 under AMP (f32 master weights stay in
+# the state; casts fuse into the matmul). The analog of the reference's AMP
+# cast insertion (reference: contrib/mixed_precision/fp16_utils.py:67), but
+# bf16 needs no loss scaling (SURVEY.md section 7 phase 4).
+AMP_OP_TYPES = {
+    "mul",
+    "matmul",
+    "conv2d",
+    "depthwise_conv2d",
+    "conv2d_transpose",
+    "scaled_dot_product_attention",
+}
+
+
+def _amp_cast_ins(ins):
+    import jax.numpy as _jnp
+
+    out = {}
+    for slot, vals in ins.items():
+        out[slot] = [
+            v.astype(_jnp.bfloat16)
+            if v is not None and hasattr(v, "dtype") and v.dtype == _jnp.float32
+            else v
+            for v in vals
+        ]
+    return out
+
+
+def _amp_cast_outs(outs):
+    import jax.numpy as _jnp
+
+    res = {}
+    for slot, vals in outs.items():
+        res[slot] = [
+            v.astype(_jnp.float32)
+            if v is not None and hasattr(v, "dtype") and v.dtype == _jnp.bfloat16
+            else v
+            for v in vals
+        ]
+    return res
+
 
 def resolve_op_def(op_type: str) -> OpDef:
     """Resolve an op type to its kernel, deriving ``*_grad`` on demand."""
@@ -111,8 +152,10 @@ def lower_block(
     block_idx: int,
     feed_names: Sequence[str],
     fetch_names: Sequence[str],
+    amp: bool = False,
 ) -> LoweredBlock:
     block = program.blocks[block_idx]
+    amp = amp or getattr(program, "_amp", False)
     state_in, state_out = analyze_state(block, feed_names)
     state_in, state_out = tuple(state_in), tuple(state_out)
     feed_names = tuple(feed_names)
@@ -137,7 +180,16 @@ def lower_block(
             if opdef.needs_rng:
                 fold = op.attrs.get("forward_op_idx", idx)
                 kwargs["rng"] = jax.random.fold_in(key, fold)
-            outs = opdef.compute(ins, dict(op.attrs), **kwargs)
+            base_type = (
+                op.type[: -len(GRAD_OP_SUFFIX)]
+                if op.type.endswith(GRAD_OP_SUFFIX)
+                else op.type
+            )
+            if amp and base_type in AMP_OP_TYPES:
+                ins = _amp_cast_ins(ins)
+                outs = _amp_cast_outs(opdef.compute(ins, dict(op.attrs), **kwargs))
+            else:
+                outs = opdef.compute(ins, dict(op.attrs), **kwargs)
             for slot, names in op.outputs.items():
                 vals = outs.get(slot, [])
                 for i, n in enumerate(names):

@@ -109,6 +109,7 @@ class Executor:
         key = (
             id(program),
             program.version,
+            getattr(program, "_amp", False),
             id(compiled) if compiled is not None else 0,
             sig,
             tuple(fetch_names),

@@ -440,6 +440,8 @@ class Program:
         self.current_block_idx = 0
         self._version = 0
         self.random_seed: Optional[int] = None
+        # bf16 mixed-precision execution flag (see paddle_tpu/amp.py)
+        self._amp = False
         # populated by append_backward: {param_name: grad_name}
         self._param_grad_map: Dict[str, str] = {}
 
@@ -535,6 +537,7 @@ class Program:
     def clone(self, for_test: bool = False) -> "Program":
         p = Program.parse_from_string(self.desc_str())
         p._param_grad_map = dict(self._param_grad_map)
+        p._amp = self._amp
         if for_test:
             for b in p.blocks:
                 for op in b.ops:

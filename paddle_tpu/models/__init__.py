@@ -1,0 +1,3 @@
+"""Model zoo (reference: benchmark/fluid/models/ + tests/book models)."""
+
+from paddle_tpu.models import mnist, resnet, transformer, vgg  # noqa: F401

@@ -1,0 +1,41 @@
+"""MNIST models (reference: benchmark/fluid/models/mnist.py and
+tests/book/test_recognize_digits.py conv/mlp variants)."""
+
+from __future__ import annotations
+
+from paddle_tpu import layers
+
+
+def mlp(img, label):
+    h1 = layers.fc(img, 200, act="relu")
+    h2 = layers.fc(h1, 200, act="relu")
+    logits = layers.fc(h2, 10)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(logits, label)
+    return loss, acc, logits
+
+
+def conv_net(img, label):
+    """LeNet-style conv net (reference: benchmark/fluid/models/mnist.py
+    cnn_model)."""
+    x = layers.reshape(img, [-1, 1, 28, 28])
+    c1 = layers.conv2d(x, 20, 5, act="relu")
+    p1 = layers.pool2d(c1, 2, "max", 2)
+    c2 = layers.conv2d(p1, 50, 5, act="relu")
+    p2 = layers.pool2d(c2, 2, "max", 2)
+    logits = layers.fc(p2, 10)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(logits, label)
+    return loss, acc, logits
+
+
+def get_model(batch_size: int = 64, use_conv: bool = True):
+    """benchmark-harness entry (reference: benchmark/fluid/models pattern:
+    get_model returns (feeds, loss, acc))."""
+    img = layers.data("pixel", shape=[784], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+    if use_conv:
+        loss, acc, logits = conv_net(img, label)
+    else:
+        loss, acc, logits = mlp(img, label)
+    return {"feeds": [img, label], "loss": loss, "acc": acc, "logits": logits}

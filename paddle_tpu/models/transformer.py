@@ -1,0 +1,298 @@
+"""Transformer NMT model (flagship).
+
+Capability parity with the reference's Transformer benchmark model
+(reference: python/paddle/fluid/tests/unittests/dist_transformer.py:1331,
+Transformer-base on WMT16 en-de), built TPU-first:
+
+- Dense padded batches + additive attention-bias tensors instead of LoD.
+- Parameter names follow a tensor-parallel convention consumed by
+  parallel/strategy.py regex rules: column-parallel weights (`*_colp.w_*`)
+  shard their output dim over the 'model' mesh axis, row-parallel weights
+  (`*_rowp.w_*`) shard their input dim; GSPMD inserts the all-reduces.
+- Everything is ordinary Program-IR ops, so the whole train step (fwd +
+  autodiff + Adam) compiles to one XLA computation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.param_attr import ParamAttr
+
+
+class TransformerConfig:
+    """Transformer-base hyperparameters (matching the reference benchmark
+    config in dist_transformer.py ModelHyperParams)."""
+
+    def __init__(
+        self,
+        src_vocab_size: int = 10000,
+        trg_vocab_size: int = 10000,
+        max_length: int = 256,
+        d_model: int = 512,
+        d_inner: int = 2048,
+        n_head: int = 8,
+        n_layer: int = 6,
+        dropout: float = 0.1,
+        label_smooth_eps: float = 0.1,
+        dtype: str = "float32",
+    ):
+        self.src_vocab_size = src_vocab_size
+        self.trg_vocab_size = trg_vocab_size
+        self.max_length = max_length
+        self.d_model = d_model
+        self.d_inner = d_inner
+        self.n_head = n_head
+        self.n_layer = n_layer
+        self.dropout = dropout
+        self.label_smooth_eps = label_smooth_eps
+        self.dtype = dtype
+
+    @property
+    def d_head(self):
+        return self.d_model // self.n_head
+
+
+def base() -> TransformerConfig:
+    return TransformerConfig()
+
+
+def _pname(prefix: str, kind: str) -> ParamAttr:
+    # kind: colp (column-parallel), rowp (row-parallel), repl (replicated)
+    return ParamAttr(name=f"{prefix}_{kind}.w")
+
+
+def _fc(x, size, prefix, kind, act=None, num_flatten_dims=2):
+    return layers.fc(
+        x,
+        size,
+        num_flatten_dims=num_flatten_dims,
+        param_attr=ParamAttr(name=f"{prefix}_{kind}.w"),
+        bias_attr=ParamAttr(name=f"{prefix}_{kind}.b"),
+        act=act,
+    )
+
+
+def _positional_encoding(max_len: int, d_model: int) -> np.ndarray:
+    """Sinusoidal table (reference: dist_transformer.py position_encoding_init)."""
+    pos = np.arange(max_len)[:, None].astype(np.float64)
+    i = np.arange(d_model // 2)[None, :].astype(np.float64)
+    angle = pos / np.power(10000.0, 2 * i / d_model)
+    table = np.zeros((max_len, d_model), dtype=np.float32)
+    table[:, 0::2] = np.sin(angle)
+    table[:, 1::2] = np.cos(angle)
+    return table
+
+
+def _multi_head_attention(q_in, kv_in, bias, cfg: TransformerConfig, prefix: str,
+                          is_test: bool):
+    h, dh, d = cfg.n_head, cfg.d_head, cfg.d_model
+    q = _fc(q_in, d, f"{prefix}_q", "colp")
+    k = _fc(kv_in, d, f"{prefix}_k", "colp")
+    v = _fc(kv_in, d, f"{prefix}_v", "colp")
+
+    def split_heads(x):
+        x = layers.reshape(x, [0, 0, h, dh])
+        return layers.transpose(x, [0, 2, 1, 3])  # [b, h, t, dh]
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper(f"{prefix}_sdpa")
+    ctx = helper.create_variable_for_type_inference(dtype=cfg.dtype)
+    inputs = {"Q": q, "K": k, "V": v}
+    if bias is not None:
+        inputs["Bias"] = bias
+    helper.append_op(
+        "scaled_dot_product_attention",
+        inputs=inputs,
+        outputs={"Out": ctx},
+        attrs={
+            "scale": 1.0 / math.sqrt(dh),
+            "dropout_prob": float(cfg.dropout),
+            "is_test": is_test,
+        },
+    )
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, d])
+    return _fc(ctx, d, f"{prefix}_out", "rowp")
+
+
+def _ffn(x, cfg: TransformerConfig, prefix: str, is_test: bool):
+    h = _fc(x, cfg.d_inner, f"{prefix}_ffn1", "colp", act="relu")
+    if cfg.dropout and not is_test:
+        h = layers.dropout(h, cfg.dropout, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    return _fc(h, cfg.d_model, f"{prefix}_ffn2", "rowp")
+
+
+def _pre_post(x, residual, cfg, prefix, is_test):
+    """post-norm residual block wiring (reference uses preprocess 'n',
+    postprocess 'da': norm -> sublayer -> dropout -> add)."""
+    out = x
+    if cfg.dropout and not is_test:
+        out = layers.dropout(out, cfg.dropout, is_test=is_test,
+                             dropout_implementation="upscale_in_train")
+    out = layers.elementwise_add(out, residual)
+    return out
+
+
+def _ln(x, prefix):
+    return layers.layer_norm(
+        x, begin_norm_axis=2,
+        param_attr=ParamAttr(name=f"{prefix}_ln.scale"),
+        bias_attr=ParamAttr(name=f"{prefix}_ln.bias"),
+    )
+
+
+def _embed(ids, vocab, cfg: TransformerConfig, name: str, pos_table_name: str,
+           is_test: bool):
+    emb = layers.embedding(
+        ids, size=[vocab, cfg.d_model],
+        param_attr=ParamAttr(
+            name=name,
+            initializer=fluid.initializer.NormalInitializer(
+                0.0, cfg.d_model ** -0.5),
+        ),
+    )
+    emb = layers.scale(emb, scale=cfg.d_model ** 0.5)
+    pos = layers.embedding(
+        _position_ids(ids), size=[cfg.max_length, cfg.d_model],
+        param_attr=ParamAttr(
+            name=pos_table_name,
+            initializer=fluid.initializer.NumpyArrayInitializer(
+                _positional_encoding(cfg.max_length, cfg.d_model)
+            ),
+            trainable=False,
+        ),
+    )
+    x = layers.elementwise_add(emb, pos)
+    if cfg.dropout and not is_test:
+        x = layers.dropout(x, cfg.dropout, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    return x
+
+
+def _position_ids(ids):
+    """[b, t] int positions built from ops (static shapes at trace time)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("pos_ids")
+    out = helper.create_variable_for_type_inference(dtype="int64",
+                                                    stop_gradient=True)
+    helper.append_op("position_ids", inputs={"X": ids}, outputs={"Out": out})
+    return out
+
+
+def encoder_layer(x, bias, cfg, i, is_test):
+    p = f"enc{i}"
+    ln_x = _ln(x, f"{p}_preattn")
+    attn = _multi_head_attention(ln_x, ln_x, bias, cfg, f"{p}_attn", is_test)
+    x = _pre_post(attn, x, cfg, p, is_test)
+    ff = _ffn(_ln(x, f"{p}_preffn"), cfg, p, is_test)
+    return _pre_post(ff, x, cfg, p, is_test)
+
+
+def decoder_layer(x, enc_out, self_bias, cross_bias, cfg, i, is_test):
+    p = f"dec{i}"
+    attn = _multi_head_attention(_ln(x, f"{p}_preself"), _ln(x, f"{p}_preself"),
+                                 self_bias, cfg, f"{p}_self", is_test)
+    x = _pre_post(attn, x, cfg, p, is_test)
+    ln_x = _ln(x, f"{p}_precross")
+    cross = _multi_head_attention(ln_x, enc_out, cross_bias, cfg,
+                                  f"{p}_cross", is_test)
+    x = _pre_post(cross, x, cfg, p, is_test)
+    ff = _ffn(_ln(x, f"{p}_preffn"), cfg, p, is_test)
+    return _pre_post(ff, x, cfg, p, is_test)
+
+
+def build(cfg: Optional[TransformerConfig] = None, is_test: bool = False):
+    """Builds the full training graph in the current main/startup programs.
+
+    Feeds: src_ids[b,s], trg_ids[b,t], lbl_ids[b,t], src_mask[b,1,1,s] (1 =
+    real token), trg_mask is derived causally inside. Returns dict of key
+    variables."""
+    cfg = cfg or base()
+    src = layers.data("src_ids", shape=[-1], dtype="int64",
+                      append_batch_size=True)
+    trg = layers.data("trg_ids", shape=[-1], dtype="int64")
+    lbl = layers.data("lbl_ids", shape=[-1], dtype="int64")
+    src_pad = layers.data("src_pad_mask", shape=[-1], dtype="float32")  # [b,s] 1=real
+    trg_pad = layers.data("trg_pad_mask", shape=[-1], dtype="float32")  # [b,t]
+
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("attn_bias")
+    enc_bias = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("attn_bias", inputs={"PadMask": src_pad},
+                     outputs={"Out": enc_bias}, attrs={"causal": False})
+    dec_self_bias = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("attn_bias", inputs={"PadMask": trg_pad},
+                     outputs={"Out": dec_self_bias}, attrs={"causal": True})
+    cross_bias = enc_bias  # same src padding bias, broadcast over query dim
+
+    enc = _embed(src, cfg.src_vocab_size, cfg, "src_emb.w", "src_pos.w", is_test)
+    for i in range(cfg.n_layer):
+        enc = encoder_layer(enc, enc_bias, cfg, i, is_test)
+    enc = _ln(enc, "enc_post")
+
+    dec = _embed(trg, cfg.trg_vocab_size, cfg, "trg_emb.w", "trg_pos.w", is_test)
+    for i in range(cfg.n_layer):
+        dec = decoder_layer(dec, enc, dec_self_bias, cross_bias, cfg, i, is_test)
+    dec = _ln(dec, "dec_post")
+
+    logits = layers.fc(
+        dec, cfg.trg_vocab_size, num_flatten_dims=2,
+        param_attr=ParamAttr(name="proj_colp.w"), bias_attr=False,
+    )
+
+    if cfg.label_smooth_eps:
+        smooth = layers.label_smooth(
+            layers.one_hot(lbl, cfg.trg_vocab_size),
+            epsilon=cfg.label_smooth_eps,
+        )
+        ce = layers.softmax_with_cross_entropy(logits, smooth, soft_label=True)
+    else:
+        ce = layers.softmax_with_cross_entropy(
+            logits, layers.unsqueeze(lbl, [2])
+        )
+    # [b, t, 1] -> [b, t]; mask padding, normalize by real token count
+    ce = layers.reshape(ce, [0, -1])
+    masked = layers.elementwise_mul(ce, trg_pad)
+    token_count = layers.reduce_sum(trg_pad)
+    loss = layers.elementwise_div(
+        layers.reduce_sum(masked), layers.elementwise_max(
+            token_count, layers.fill_constant_like(token_count, 1.0))
+    )
+    return {
+        "feeds": [src, trg, lbl, src_pad, trg_pad],
+        "loss": loss,
+        "logits": logits,
+        "token_count": token_count,
+        "config": cfg,
+    }
+
+
+def make_batch(cfg: TransformerConfig, batch: int, src_len: int, trg_len: int,
+               seed: int = 0) -> Dict[str, np.ndarray]:
+    """Synthetic padded batch matching the feed contract."""
+    r = np.random.RandomState(seed)
+    src = r.randint(3, cfg.src_vocab_size, (batch, src_len)).astype(np.int64)
+    trg = r.randint(3, cfg.trg_vocab_size, (batch, trg_len)).astype(np.int64)
+    lbl = r.randint(3, cfg.trg_vocab_size, (batch, trg_len)).astype(np.int64)
+    src_lens = r.randint(src_len // 2, src_len + 1, batch)
+    trg_lens = r.randint(trg_len // 2, trg_len + 1, batch)
+    src_pad = (np.arange(src_len)[None, :] < src_lens[:, None]).astype(np.float32)
+    trg_pad = (np.arange(trg_len)[None, :] < trg_lens[:, None]).astype(np.float32)
+    return {
+        "src_ids": src * src_pad.astype(np.int64),
+        "trg_ids": trg * trg_pad.astype(np.int64),
+        "lbl_ids": lbl,
+        "src_pad_mask": src_pad,
+        "trg_pad_mask": trg_pad,
+    }

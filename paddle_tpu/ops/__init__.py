@@ -9,6 +9,7 @@ custom grad maker.
 
 from paddle_tpu.ops import (  # noqa: F401
     activation_ops,
+    attention_ops,
     math_ops,
     nn_ops,
     optimizer_ops,

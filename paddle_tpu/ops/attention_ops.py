@@ -1,0 +1,89 @@
+"""Attention support ops: position ids, additive attention bias, and the
+fused scaled-dot-product attention kernel (Pallas on TPU, reference JAX
+elsewhere).
+
+These replace the reference's LoD-based attention plumbing in
+dist_transformer.py (slice/pad helpers) with static-shape mask tensors
+(SURVEY.md section 5).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+NEG_INF = -1e9
+
+
+def _x(ins, slot="X", i=0):
+    v = ins.get(slot)
+    return v[i] if v else None
+
+
+@register_op("position_ids", no_grad=True)
+def _position_ids(ins, attrs):
+    x = _x(ins)  # [b, t] any int dtype
+    b, t = jnp.shape(x)[0], jnp.shape(x)[1]
+    return {"Out": [jnp.broadcast_to(jnp.arange(t, dtype=jnp.int64), (b, t))]}
+
+
+@register_op("attn_bias", no_grad=True)
+def _attn_bias(ins, attrs):
+    """PadMask [b, t_k] (1=real token) -> additive bias.
+
+    causal=False: [b, 1, 1, t_k] with -1e9 at padding.
+    causal=True:  [b, 1, t_k, t_k] padding + upper-triangular future mask.
+    """
+    mask = _x(ins, "PadMask")
+    pad_bias = (1.0 - mask) * NEG_INF  # [b, t]
+    if attrs.get("causal", False):
+        t = jnp.shape(mask)[1]
+        causal = jnp.triu(jnp.full((t, t), NEG_INF, mask.dtype), k=1)
+        out = pad_bias[:, None, None, :] + causal[None, None, :, :]
+    else:
+        out = pad_bias[:, None, None, :]
+    return {"Out": [out]}
+
+
+@register_op("scaled_dot_product_attention", diff_inputs=("Q", "K", "V"),
+             needs_rng=True)
+def _sdpa(ins, attrs, rng=None):
+    """Fused attention: Q,K,V [b, h, t, dh] + optional additive Bias.
+
+    With no attention dropout this routes to the Pallas flash-attention
+    kernel on TPU (paddle_tpu/parallel/flash_attention.py); with dropout
+    (or off-TPU, or in the numeric-grad harness) it uses the jnp
+    composition, which XLA fuses.
+    """
+    q, k, v = _x(ins, "Q"), _x(ins, "K"), _x(ins, "V")
+    bias = _x(ins, "Bias")
+    scale = attrs.get("scale", None)
+    if scale is None:
+        scale = 1.0 / math.sqrt(jnp.shape(q)[-1])
+    p_drop = attrs.get("dropout_prob", 0.0)
+    training_dropout = p_drop > 0.0 and not attrs.get("is_test", False)
+    use_pallas = (
+        jax.default_backend() == "tpu"
+        and attrs.get("use_pallas", True)
+        and not training_dropout
+    )
+    if use_pallas:
+        from paddle_tpu.parallel.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, bias=bias, scale=scale)
+    else:
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        if bias is not None:
+            scores = scores + bias.astype(scores.dtype)
+        attn = jax.nn.softmax(scores, axis=-1)
+        if training_dropout:
+            keep = jax.random.bernoulli(rng, 1.0 - p_drop, jnp.shape(attn))
+            attn = jnp.where(keep, attn / (1.0 - p_drop), 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", attn.astype(v.dtype), v)
+    return {"Out": [out.astype(q.dtype)]}

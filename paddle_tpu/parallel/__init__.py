@@ -1,0 +1,19 @@
+"""Parallelism: device meshes, sharding strategies, collectives, long-context.
+
+TPU-native replacement for the reference's distributed stack (SURVEY.md
+section 2.3): NCCL allreduce rings / hierarchical allreduce / gradient
+fusion (reference: platform/nccl_helper.h:90-210,
+details/all_reduce_op_handle.cc:86, fuse_all_reduce_op_pass.cc) become
+GSPMD shardings over a jax Mesh with XLA collectives on ICI; the
+parameter-server path (reference: operators/distributed_ops/
+listen_and_serv_op.cc:109) becomes sharded embedding tables + all-to-all
+(embedding.py); ring attention covers the long-context capability the
+reference lacks (SURVEY.md section 5).
+"""
+
+from paddle_tpu.parallel.mesh import create_mesh, get_mesh, set_mesh  # noqa: F401
+from paddle_tpu.parallel.strategy import (  # noqa: F401
+    DistributedStrategy,
+    ShardingRule,
+    transformer_rules,
+)

@@ -1,0 +1,52 @@
+"""Sharded embedding tables (expert/table parallelism).
+
+The TPU-native replacement for the reference's distributed lookup table:
+rows sharded across parameter servers with RPC prefetch-by-ids (reference:
+operators/distributed/parameter_prefetch.cc, transpiler
+distribute_transpiler.py:1317) and pslib Downpour sparse tables (reference:
+framework/fleet/fleet_wrapper.h:62). Here the table is sharded over a mesh
+axis; each device gathers its local rows and a psum over the axis combines
+partial results (ids outside a shard contribute zeros) — all-to-all traffic
+rides ICI instead of pserver RPC.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _sharded_lookup_local(w_local, ids, *, axis_name: str):
+    """w_local: [V_loc, D] this shard's rows; ids: [...] global ids
+    (replicated). Rows outside the shard contribute zero; psum combines."""
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    v_loc = w_local.shape[0]
+    lo = rank * v_loc
+    local_ids = ids - lo
+    in_shard = (local_ids >= 0) & (local_ids < v_loc)
+    safe = jnp.clip(local_ids, 0, v_loc - 1)
+    rows = jnp.take(w_local, safe, axis=0)
+    rows = rows * in_shard[..., None].astype(rows.dtype)
+    return jax.lax.psum(rows, axis_name)
+
+
+def sharded_embedding_lookup(
+    table,
+    ids,
+    mesh: Mesh,
+    shard_axis: str = "model",
+):
+    """table: [V, D] sharded over rows on ``shard_axis``; ids: any int shape
+    (replicated). Returns gathered embeddings [..., D] (replicated)."""
+    fn = jax.shard_map(
+        functools.partial(_sharded_lookup_local, axis_name=shard_axis),
+        mesh=mesh,
+        in_specs=(P(shard_axis, None), P()),
+        out_specs=P(),
+    )
+    return fn(table, ids)

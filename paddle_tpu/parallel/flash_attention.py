@@ -1,0 +1,141 @@
+"""Pallas TPU attention kernel.
+
+The hot op of the transformer family (SURVEY.md section 7: "pallas kernels
+for the hot ops"). Forward runs as a Pallas kernel that keeps the score
+matrix for one query block in VMEM — scores never round-trip to HBM, the
+two matmuls hit the MXU back-to-back. Backward recomputes through the jnp
+composition under custom_vjp (flash-style rematerialization: trade FLOPs
+for HBM, XLA fuses the recompute).
+
+Layout: q, k, v are [b, h, t, dh]; bias is additive [b, 1|h, tq, tk].
+Block size over queries is 256 (fits (256, t) f32 scores in VMEM for the
+sequence lengths the benchmarks use; lane dim dh is zero-padded to 128 by
+Mosaic automatically).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_Q_BLOCK = 256
+
+
+def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale):
+    # q_ref: [1, Bq, dh]; k_ref/v_ref: [1, t, dh]; bias_ref: [1, Bq, t]
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0] = (o / l).astype(o_ref.dtype)
+
+
+def _reference_attention(q, k, v, bias, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(s.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def flash_attention(q, k, v, bias=None, scale: Optional[float] = None,
+                    q_block: int = DEFAULT_Q_BLOCK):
+    return _flash_fwd(q, k, v, bias, scale, q_block)[0]
+
+
+def _flash_fwd(q, k, v, bias, scale, q_block):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    b, h, tq, dh = q.shape
+    tk = k.shape[2]
+    bq = min(q_block, tq)
+    if tq % bq != 0 or jax.default_backend() != "tpu":
+        out = _reference_attention(q, k, v, bias, scale)
+        return out, (q, k, v, bias)
+
+    bh = b * h
+    q_r = q.reshape(bh, tq, dh)
+    k_r = k.reshape(bh, tk, dh)
+    v_r = v.reshape(bh, tk, dh)
+    nq = tq // bq
+
+    in_specs = [
+        pl.BlockSpec((1, bq, dh), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, tk, dh), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, tk, dh), lambda i, j: (i, 0, 0)),
+    ]
+    args = [q_r, k_r, v_r]
+    if bias is not None:
+        # Never materialize a broadcast bias: keep the stored rank
+        # ([b,1,1,tk] pad rows or [b,1|h,tq,tk] causal) and index size-1
+        # dims with a constant 0 block; the kernel broadcasts in VMEM.
+        hb, tq_b = bias.shape[1], bias.shape[2]
+        if hb == 1:
+            bias_bh = bias.reshape(b, tq_b, tk)
+            if tq_b == 1:
+                spec = pl.BlockSpec((1, 1, tk), lambda i, j, h=h: (i // h, 0, 0))
+            else:
+                spec = pl.BlockSpec((1, bq, tk), lambda i, j, h=h: (i // h, j, 0))
+        else:
+            bias_bh = bias.reshape(bh, tq_b, tk)
+            if tq_b == 1:
+                spec = pl.BlockSpec((1, 1, tk), lambda i, j: (i, 0, 0))
+            else:
+                spec = pl.BlockSpec((1, bq, tk), lambda i, j: (i, j, 0))
+        in_specs.append(spec)
+        args.append(bias_bh)
+        kernel = functools.partial(_attn_fwd_kernel, scale=scale)
+    else:
+        kernel = functools.partial(
+            lambda qr, kr, vr, orf, scale: _attn_fwd_kernel(
+                qr, kr, vr, None, orf, scale=scale),
+            scale=scale,
+        )
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, nq),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, dh), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, dh), q.dtype),
+    )(*args)
+    return out.reshape(b, h, tq, dh), (q, k, v, bias)
+
+
+def _flash_bwd(scale, q_block, res, g):
+    q, k, v, bias = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def f(q, k, v, bias):
+        return _reference_attention(q, k, v, bias, scale)
+
+    if bias is None:
+        _, vjp = jax.vjp(lambda a, b, c: f(a, b, c, None), q, k, v)
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, None
+    _, vjp = jax.vjp(f, q, k, v, bias)
+    dq, dk, dv, dbias = vjp(g)
+    return dq, dk, dv, dbias
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

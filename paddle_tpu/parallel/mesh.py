@@ -1,0 +1,73 @@
+"""Device mesh management.
+
+Replaces the reference's device-topology plumbing (places lists, NCCL
+context maps, `nccl_comm_num` rings, hierarchical inter/exter comms —
+reference: platform/nccl_helper.h:90-210, parallel_executor.cc:343-366)
+with one object: a named `jax.sharding.Mesh`. Multi-host comes from
+jax.distributed + the same mesh spanning all processes; ICI vs DCN layout
+is expressed by axis order (outer axes ride DCN across slices).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+_current_mesh: Optional[Mesh] = None
+
+
+def create_mesh(
+    axes: Dict[str, int],
+    devices: Optional[Sequence] = None,
+    set_as_default: bool = True,
+) -> Mesh:
+    """Create a named mesh, e.g. create_mesh({"data": 4, "model": 2}).
+
+    Axis sizes must multiply to the device count; -1 on one axis infers it.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devs) // known
+    total = int(np.prod(sizes))
+    if total != len(devs):
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+            f"have {len(devs)}"
+        )
+    arr = np.asarray(devs).reshape(sizes)
+    mesh = Mesh(arr, tuple(names))
+    if set_as_default:
+        set_mesh(mesh)
+    return mesh
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None):
+    """Multi-host bootstrap (replaces gen_nccl_id RPC bootstrap, reference:
+    operators/distributed_ops/gen_nccl_id_op.cc:62): the PJRT distributed
+    runtime's KV store handles device discovery and barriers."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    jax.distributed.initialize(**kwargs)

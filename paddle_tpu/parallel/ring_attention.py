@@ -1,0 +1,113 @@
+"""Ring attention: context parallelism over the sequence axis.
+
+Net-new capability vs the reference (SURVEY.md section 5 "long-context":
+the 2019 codebase has LoD sequence ops but no way to exceed one device's
+memory for a single sequence). Design: shard the sequence axis of Q/K/V
+over a mesh axis; each device holds one block and passes its K/V block
+around the ring with `lax.ppermute` (ICI neighbor exchange), accumulating
+the attention output with the online-softmax (log-sum-exp) recurrence, so
+the full t x t score matrix never materializes on any chip and compute
+overlaps the ring transfer.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: float):
+    """Per-shard body (runs inside shard_map).
+
+    q: [b, h, tq_loc, dh]; k, v: [b, h, tk_loc, dh] (this rank's block).
+    """
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    tq = q.shape[2]
+    tk = k.shape[2]
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_f32 = q.astype(jnp.float32)
+
+    def step(carry, i):
+        k_blk, v_blk, m, l, o = carry
+        # source rank of this block: blocks rotate forward each step, so at
+        # step i we hold the block of rank (rank - i) mod n.
+        src = (rank - i) % n
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_f32, k_blk.astype(jnp.float32))
+        s = s * scale
+        if causal:
+            q_pos = rank * tq + jnp.arange(tq)
+            k_pos = src * tk + jnp.arange(tk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, -1e9)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        o_new = o * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_next, v_next, m_new, l_new, o_new), None
+
+    b, h = q.shape[0], q.shape[1]
+    m0 = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    o0 = jnp.zeros((b, h, tq, q.shape[3]), jnp.float32)
+    # initial carries are rank-invariant; mark them varying over the ring
+    # axis so the scan carry type matches the per-rank outputs
+    m0, l0, o0 = jax.lax.pcast((m0, l0, o0), (axis_name,), to="varying")
+    (k_f, v_f, m, l, o), _ = jax.lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(n)
+    )
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    mesh: Mesh,
+    seq_axis: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Sequence-parallel attention: q, k, v are [b, h, t, dh] GLOBAL arrays
+    (sharded or shardable over ``seq_axis`` on dim 2)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(None, None, seq_axis, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_attention_local,
+            axis_name=seq_axis,
+            causal=causal,
+            scale=scale,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, causal: bool = False, scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t = q.shape[2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -1e9)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -1,0 +1,64 @@
+"""Ring attention vs dense reference on the 8-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from paddle_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_attention,
+)
+
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.array(jax.devices()), ("sp",))
+
+
+def _qkv(seed, b=2, h=2, t=32, dh=8):
+    r = np.random.RandomState(seed)
+    mk = lambda: r.randn(b, h, t, dh).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+def test_ring_attention_matches_dense(mesh):
+    q, k, v = _qkv(0)
+    out = ring_attention(q, k, v, mesh, "sp", causal=False)
+    ref = reference_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ring_attention_causal(mesh):
+    q, k, v = _qkv(1)
+    out = ring_attention(q, k, v, mesh, "sp", causal=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ring_attention_grad_matches(mesh):
+    q, k, v = _qkv(2, t=16)
+
+    def loss_ring(q, k, v):
+        return ring_attention(q, k, v, mesh, "sp", causal=True).sum()
+
+    def loss_ref(q, k, v):
+        return reference_attention(q, k, v, causal=True).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=1e-3)
+
+
+def test_sharded_embedding_lookup(mesh):
+    from paddle_tpu.parallel.embedding import sharded_embedding_lookup
+
+    r = np.random.RandomState(0)
+    table = r.randn(64, 16).astype(np.float32)  # 8 rows per device
+    ids = r.randint(0, 64, (4, 7)).astype(np.int32)
+    out = sharded_embedding_lookup(table, ids, mesh, "sp")
+    np.testing.assert_allclose(np.asarray(out), table[ids], atol=1e-6)
