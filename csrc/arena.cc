@@ -1,0 +1,112 @@
+// Best-fit host memory arena with coalescing free list.
+//
+// Native-parity component for the reference's memory manager — the
+// best-fit allocator and buddy allocator behind AllocatorFacade
+// (reference: paddle/fluid/memory/allocation/best_fit_allocator.h,
+// memory/detail/buddy_allocator.cc). On TPU, HBM allocation belongs to
+// XLA/PJRT (buffer donation + compiler buffer assignment replaces the
+// device-side arena, SURVEY.md section 7 phase 2); what the runtime still
+// owns is *host* staging memory: aligned, reusable buffers that feed the
+// infeed pipeline without malloc churn. Exposed via ctypes and used by the
+// data plane.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+
+namespace {
+
+struct Arena {
+  uint8_t* base = nullptr;
+  size_t capacity = 0;
+  std::mutex mu;
+  // offset -> size
+  std::map<size_t, size_t> free_blocks;
+  std::map<size_t, size_t> used_blocks;
+  size_t peak = 0;
+  size_t in_use = 0;
+
+  explicit Arena(size_t cap) : capacity(cap) {
+    base = static_cast<uint8_t*>(aligned_alloc(4096, cap));
+    if (base) free_blocks[0] = cap;
+  }
+  ~Arena() { free(base); }
+};
+
+constexpr size_t kAlign = 64;  // cache line
+
+size_t align_up(size_t x) { return (x + kAlign - 1) & ~(kAlign - 1); }
+
+}  // namespace
+
+extern "C" {
+
+void* arena_create(uint64_t capacity) {
+  Arena* a = new Arena(align_up(capacity));
+  if (!a->base) {
+    delete a;
+    return nullptr;
+  }
+  return a;
+}
+
+void arena_destroy(void* h) { delete static_cast<Arena*>(h); }
+
+// Best-fit: smallest free block that fits. Returns pointer or null.
+void* arena_alloc(void* h, uint64_t size) {
+  Arena* a = static_cast<Arena*>(h);
+  size = align_up(size ? size : 1);
+  std::lock_guard<std::mutex> l(a->mu);
+  std::map<size_t, size_t>::iterator best = a->free_blocks.end();
+  for (auto it = a->free_blocks.begin(); it != a->free_blocks.end(); ++it) {
+    if (it->second >= size &&
+        (best == a->free_blocks.end() || it->second < best->second)) {
+      best = it;
+    }
+  }
+  if (best == a->free_blocks.end()) return nullptr;
+  size_t off = best->first;
+  size_t blk = best->second;
+  a->free_blocks.erase(best);
+  if (blk > size) a->free_blocks[off + size] = blk - size;
+  a->used_blocks[off] = size;
+  a->in_use += size;
+  if (a->in_use > a->peak) a->peak = a->in_use;
+  return a->base + off;
+}
+
+int arena_free(void* h, void* ptr) {
+  Arena* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> l(a->mu);
+  size_t off = static_cast<uint8_t*>(ptr) - a->base;
+  auto it = a->used_blocks.find(off);
+  if (it == a->used_blocks.end()) return -1;
+  size_t size = it->second;
+  a->used_blocks.erase(it);
+  a->in_use -= size;
+  // coalesce with neighbors
+  auto next = a->free_blocks.lower_bound(off);
+  if (next != a->free_blocks.end() && off + size == next->first) {
+    size += next->second;
+    a->free_blocks.erase(next);
+  }
+  if (!a->free_blocks.empty()) {
+    auto prev = a->free_blocks.lower_bound(off);
+    if (prev != a->free_blocks.begin()) {
+      --prev;
+      if (prev->first + prev->second == off) {
+        prev->second += size;
+        return 0;
+      }
+    }
+  }
+  a->free_blocks[off] = size;
+  return 0;
+}
+
+uint64_t arena_in_use(void* h) { return static_cast<Arena*>(h)->in_use; }
+uint64_t arena_peak(void* h) { return static_cast<Arena*>(h)->peak; }
+
+}  // extern "C"

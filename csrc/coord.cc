@@ -1,0 +1,319 @@
+// Coordination service: TCP key-value store + barriers for multi-host
+// bootstrap and control-plane sync.
+//
+// Native-parity replacement for the reference's collective bootstrap and
+// barrier machinery — exchanging ncclUniqueId over RPC (reference:
+// operators/distributed_ops/gen_nccl_id_op.cc:62) and pserver barrier
+// counters (reference: operators/distributed_ops/listen_and_serv_op.cc:135).
+// On TPU pods the data-plane collectives are XLA/ICI; what remains is a
+// small control-plane: rendezvous (PUT/GET with blocking waits), barriers,
+// and liveness (heartbeat timestamps for failure detection, SURVEY.md
+// section 5 "failure detection").
+//
+// Wire protocol (length-prefixed): u32 len | u8 op | payload.
+//   op 'P': PUT  key\0value      -> "OK"
+//   op 'G': GET  key\0timeout_ms -> value (blocks until present or timeout)
+//   op 'B': BARRIER name\0count  -> "OK" when count participants arrived
+//   op 'H': HEARTBEAT id         -> "OK" (records monotonic timestamp)
+//   op 'L': LIVENESS max_age_ms  -> comma-joined ids considered dead
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Server {
+  int listen_fd = -1;
+  std::thread accept_thread;
+  bool stopping = false;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> kv;
+  std::map<std::string, int> barrier_count;
+  std::map<std::string, int> barrier_gen;
+  std::map<std::string, Clock::time_point> heartbeats;
+  std::vector<std::thread> workers;
+  std::vector<int> conn_fds;  // open connections, shut down on stop
+
+  ~Server() { stop(); }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> l(mu);
+      if (stopping) return;
+      stopping = true;
+      // unblock worker threads parked in recv() on live connections
+      for (int fd : conn_fds) shutdown(fd, SHUT_RDWR);
+    }
+    cv.notify_all();
+    if (listen_fd >= 0) {
+      shutdown(listen_fd, SHUT_RDWR);
+      close(listen_fd);
+      listen_fd = -1;
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    for (auto& w : workers)
+      if (w.joinable()) w.join();
+  }
+};
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t k = send(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= size_t(k);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t k = recv(fd, p, n, 0);
+    if (k <= 0) return false;
+    p += k;
+    n -= size_t(k);
+  }
+  return true;
+}
+
+bool send_msg(int fd, const std::string& s) {
+  uint32_t len = htonl(uint32_t(s.size()));
+  return send_all(fd, &len, 4) && send_all(fd, s.data(), s.size());
+}
+
+bool recv_msg(int fd, std::string* s) {
+  uint32_t len;
+  if (!recv_all(fd, &len, 4)) return false;
+  len = ntohl(len);
+  if (len > (64u << 20)) return false;
+  s->resize(len);
+  return len == 0 || recv_all(fd, &(*s)[0], len);
+}
+
+void handle_conn(Server* srv, int fd) {
+  std::string msg;
+  while (recv_msg(fd, &msg)) {
+    if (msg.empty()) break;
+    char op = msg[0];
+    std::string body = msg.substr(1);
+    size_t sep = body.find('\0');
+    std::string a = sep == std::string::npos ? body : body.substr(0, sep);
+    std::string b = sep == std::string::npos ? "" : body.substr(sep + 1);
+    if (op == 'P') {
+      {
+        std::lock_guard<std::mutex> l(srv->mu);
+        srv->kv[a] = b;
+      }
+      srv->cv.notify_all();
+      if (!send_msg(fd, "OK")) break;
+    } else if (op == 'G') {
+      int timeout_ms = b.empty() ? -1 : atoi(b.c_str());
+      std::unique_lock<std::mutex> l(srv->mu);
+      auto pred = [&] { return srv->stopping || srv->kv.count(a); };
+      bool ok;
+      if (timeout_ms < 0) {
+        srv->cv.wait(l, pred);
+        ok = srv->kv.count(a) > 0;
+      } else {
+        ok = srv->cv.wait_for(l, std::chrono::milliseconds(timeout_ms), pred) &&
+             srv->kv.count(a);
+      }
+      std::string val = ok ? srv->kv[a] : "";
+      l.unlock();
+      if (!send_msg(fd, ok ? "V" + val : "E")) break;
+    } else if (op == 'B') {
+      int want = atoi(b.c_str());
+      std::unique_lock<std::mutex> l(srv->mu);
+      int my_gen = srv->barrier_gen[a];
+      if (++srv->barrier_count[a] >= want) {
+        srv->barrier_count[a] = 0;
+        srv->barrier_gen[a]++;
+        srv->cv.notify_all();
+      } else {
+        srv->cv.wait(l, [&] {
+          return srv->stopping || srv->barrier_gen[a] != my_gen;
+        });
+      }
+      l.unlock();
+      if (!send_msg(fd, "OK")) break;
+    } else if (op == 'H') {
+      {
+        std::lock_guard<std::mutex> l(srv->mu);
+        srv->heartbeats[a] = Clock::now();
+      }
+      if (!send_msg(fd, "OK")) break;
+    } else if (op == 'L') {
+      int max_age_ms = atoi(a.c_str());
+      std::string dead;
+      {
+        std::lock_guard<std::mutex> l(srv->mu);
+        auto now = Clock::now();
+        for (auto& it : srv->heartbeats) {
+          auto age = std::chrono::duration_cast<std::chrono::milliseconds>(
+                         now - it.second)
+                         .count();
+          if (age > max_age_ms) {
+            if (!dead.empty()) dead += ",";
+            dead += it.first;
+          }
+        }
+      }
+      if (!send_msg(fd, dead)) break;
+    } else {
+      break;
+    }
+  }
+  close(fd);
+}
+
+struct Client {
+  int fd = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* coord_server_start(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(uint16_t(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  Server* srv = new Server();
+  srv->listen_fd = fd;
+  srv->accept_thread = std::thread([srv] {
+    for (;;) {
+      int cfd = accept(srv->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;
+      std::lock_guard<std::mutex> l(srv->mu);
+      if (srv->stopping) {
+        close(cfd);
+        break;
+      }
+      srv->conn_fds.push_back(cfd);
+      srv->workers.emplace_back(handle_conn, srv, cfd);
+    }
+  });
+  return srv;
+}
+
+void coord_server_stop(void* h) {
+  Server* srv = static_cast<Server*>(h);
+  srv->stop();
+  delete srv;
+}
+
+void* coord_client_connect(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(uint16_t(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1 ||
+      connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  Client* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+void coord_client_close(void* h) {
+  Client* c = static_cast<Client*>(h);
+  close(c->fd);
+  delete c;
+}
+
+static int roundtrip(Client* c, const std::string& req, std::string* resp) {
+  if (!send_msg(c->fd, req)) return -1;
+  if (!recv_msg(c->fd, resp)) return -1;
+  return 0;
+}
+
+int coord_put(void* h, const char* key, const uint8_t* val, uint32_t len) {
+  Client* c = static_cast<Client*>(h);
+  std::string req = "P";
+  req += key;
+  req += '\0';
+  req.append(reinterpret_cast<const char*>(val), len);
+  std::string resp;
+  return roundtrip(c, req, &resp) == 0 && resp == "OK" ? 0 : -1;
+}
+
+// returns length (>=0) and copies into out (cap bytes);
+// -1 timeout/absent, -2 connection error, -(n+3) value present but needs
+// n bytes (> cap).
+int coord_get(void* h, const char* key, int timeout_ms, uint8_t* out,
+              uint32_t cap) {
+  Client* c = static_cast<Client*>(h);
+  std::string req = "G";
+  req += key;
+  req += '\0';
+  req += std::to_string(timeout_ms);
+  std::string resp;
+  if (roundtrip(c, req, &resp) != 0) return -2;
+  if (resp.empty() || resp[0] != 'V') return -1;
+  uint32_t n = uint32_t(resp.size() - 1);
+  if (n > cap) return -int(n) - 3;
+  memcpy(out, resp.data() + 1, n);
+  return int(n);
+}
+
+int coord_barrier(void* h, const char* name, int count) {
+  Client* c = static_cast<Client*>(h);
+  std::string req = "B";
+  req += name;
+  req += '\0';
+  req += std::to_string(count);
+  std::string resp;
+  return roundtrip(c, req, &resp) == 0 && resp == "OK" ? 0 : -1;
+}
+
+int coord_heartbeat(void* h, const char* id) {
+  Client* c = static_cast<Client*>(h);
+  std::string req = "H";
+  req += id;
+  std::string resp;
+  return roundtrip(c, req, &resp) == 0 && resp == "OK" ? 0 : -1;
+}
+
+int coord_dead_peers(void* h, int max_age_ms, char* out, uint32_t cap) {
+  Client* c = static_cast<Client*>(h);
+  std::string req = "L";
+  req += std::to_string(max_age_ms);
+  std::string resp;
+  if (roundtrip(c, req, &resp) != 0) return -1;
+  if (resp.size() + 1 > cap) return -1;
+  memcpy(out, resp.c_str(), resp.size() + 1);
+  return int(resp.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,121 @@
+// Host-side span profiler -> chrome://tracing JSON.
+//
+// Native-parity component for the reference's host profiler —
+// RecordEvent RAII spans + Enable/DisableProfiler state machine
+// (reference: paddle/fluid/platform/profiler.h:81,166) and the
+// tools/timeline.py chrome-trace conversion (reference:
+// tools/timeline.py:283). Device-side timing is XLA's own profiler
+// (xplane); this covers the host runtime: executor dispatch, infeed,
+// checkpoint, python-annotated spans. Thread-safe, per-thread buffers
+// flushed on dump.
+
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Event {
+  std::string name;
+  uint64_t ts_us;
+  uint64_t dur_us;
+  long tid;
+};
+
+struct Profiler {
+  std::mutex mu;
+  std::vector<Event> events;
+  std::atomic<bool> enabled{false};
+  std::atomic<uint64_t> epoch{0};  // bumped on enable; stale spans dropped
+  Clock::time_point start;
+};
+
+Profiler g_prof;
+
+struct Span {
+  std::string name;
+  Clock::time_point start;
+  uint64_t epoch;
+};
+
+thread_local std::vector<Span> t_stack;
+
+uint64_t us_since_start(Clock::time_point t) {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             t - g_prof.start)
+      .count();
+}
+
+}  // namespace
+
+extern "C" {
+
+void prof_enable() {
+  std::lock_guard<std::mutex> l(g_prof.mu);
+  g_prof.start = Clock::now();
+  g_prof.events.clear();
+  g_prof.epoch.fetch_add(1);
+  g_prof.enabled.store(true);
+}
+
+void prof_disable() { g_prof.enabled.store(false); }
+
+int prof_is_enabled() { return g_prof.enabled.load() ? 1 : 0; }
+
+void prof_begin(const char* name) {
+  if (!g_prof.enabled.load()) return;
+  t_stack.push_back({name, Clock::now(), g_prof.epoch.load()});
+}
+
+void prof_end() {
+  // always pop a matching span so begin/end stay balanced even when
+  // profiling is toggled mid-span; record only spans from the live epoch
+  if (t_stack.empty()) return;
+  Span span = std::move(t_stack.back());
+  t_stack.pop_back();
+  if (!g_prof.enabled.load() || span.epoch != g_prof.epoch.load()) return;
+  auto now = Clock::now();
+  Event e;
+  e.name = std::move(span.name);
+  e.ts_us = us_since_start(span.start);
+  e.dur_us = std::chrono::duration_cast<std::chrono::microseconds>(
+                 now - span.start)
+                 .count();
+  e.tid = syscall(SYS_gettid);
+  std::lock_guard<std::mutex> l(g_prof.mu);
+  g_prof.events.push_back(std::move(e));
+}
+
+// Writes chrome://tracing JSON. Returns number of events, -1 on error.
+int prof_dump(const char* path) {
+  std::lock_guard<std::mutex> l(g_prof.mu);
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  fputs("{\"traceEvents\":[", f);
+  for (size_t i = 0; i < g_prof.events.size(); ++i) {
+    const Event& e = g_prof.events[i];
+    std::string name = e.name;
+    for (auto& c : name)
+      if (c == '"' || c == '\\' || (unsigned char)c < 0x20) c = '_';
+    fprintf(f,
+            "%s{\"name\":\"%s\",\"ph\":\"X\",\"pid\":%d,\"tid\":%ld,"
+            "\"ts\":%llu,\"dur\":%llu}",
+            i ? "," : "", name.c_str(), getpid(), e.tid,
+            (unsigned long long)e.ts_us, (unsigned long long)e.dur_us);
+  }
+  fputs("]}", f);
+  fclose(f);
+  return int(g_prof.events.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,199 @@
+// RecordIO: chunked, CRC-checked record container.
+//
+// Native-parity component for the reference's C++ RecordIO
+// (reference: paddle/fluid/recordio/{header,chunk,scanner,writer}.h):
+// records are grouped into chunks, each chunk carries a magic number,
+// compressor tag, CRC32 and record count, so a scanner can skip torn or
+// corrupt chunks (crash-tolerant appends) and seek chunk-by-chunk.
+// Differences by design: compression is raw zlib (always available in this
+// image) instead of snappy, and the chunk layout is little-endian fixed
+// u32 fields with no protobuf dependency.
+//
+// Layout per chunk:
+//   u32 magic (0x50545231 "PTR1") | u32 compressor (0 none, 1 zlib)
+//   u32 num_records | u32 payload_len | u32 crc32(payload)
+//   payload: concatenated (u32 len | bytes) records, possibly compressed.
+
+#include <zlib.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50545231;
+constexpr size_t kChunkFlushBytes = 1 << 20;  // 1 MiB
+
+struct Writer {
+  FILE* f = nullptr;
+  int compressor = 0;
+  std::vector<uint8_t> buf;
+  uint32_t num_records = 0;
+
+  void append_u32(std::vector<uint8_t>* v, uint32_t x) {
+    uint8_t b[4] = {uint8_t(x), uint8_t(x >> 8), uint8_t(x >> 16),
+                    uint8_t(x >> 24)};
+    v->insert(v->end(), b, b + 4);
+  }
+
+  int flush_chunk() {
+    if (num_records == 0) return 0;
+    std::vector<uint8_t> payload;
+    if (compressor == 1) {
+      uLongf dst_len = compressBound(buf.size());
+      payload.resize(dst_len);
+      if (compress2(payload.data(), &dst_len, buf.data(), buf.size(), 6) !=
+          Z_OK)
+        return -1;
+      payload.resize(dst_len);
+    } else {
+      payload = buf;
+    }
+    uint32_t crc = crc32(0L, payload.data(), payload.size());
+    std::vector<uint8_t> header;
+    append_u32(&header, kMagic);
+    append_u32(&header, uint32_t(compressor));
+    append_u32(&header, num_records);
+    append_u32(&header, uint32_t(payload.size()));
+    append_u32(&header, crc);
+    if (fwrite(header.data(), 1, header.size(), f) != header.size()) return -1;
+    if (fwrite(payload.data(), 1, payload.size(), f) != payload.size())
+      return -1;
+    buf.clear();
+    num_records = 0;
+    return 0;
+  }
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  std::vector<uint8_t> chunk;     // decompressed payload of current chunk
+  size_t pos = 0;                 // cursor within chunk
+  std::vector<uint8_t> last_record;
+
+  static bool read_u32(FILE* f, uint32_t* out) {
+    uint8_t b[4];
+    if (fread(b, 1, 4, f) != 4) return false;
+    *out = uint32_t(b[0]) | uint32_t(b[1]) << 8 | uint32_t(b[2]) << 16 |
+           uint32_t(b[3]) << 24;
+    return true;
+  }
+
+  // Loads the next valid chunk; skips corrupt ones (CRC mismatch / bad
+  // magic) by scanning forward for the magic marker.
+  bool next_chunk() {
+    for (;;) {
+      uint32_t magic;
+      if (!read_u32(f, &magic)) return false;
+      if (magic != kMagic) {
+        // resync: step back 3 bytes and keep searching
+        if (fseek(f, -3, SEEK_CUR) != 0) return false;
+        continue;
+      }
+      uint32_t comp, nrec, plen, crc;
+      if (!read_u32(f, &comp) || !read_u32(f, &nrec) || !read_u32(f, &plen) ||
+          !read_u32(f, &crc))
+        return false;
+      // a corrupted length field must not trigger a giant allocation;
+      // resync past this header instead (writer never exceeds ~2x the
+      // flush threshold even before compression)
+      if (plen > (64u << 20)) {
+        if (fseek(f, -19, SEEK_CUR) != 0) return false;
+        continue;
+      }
+      std::vector<uint8_t> payload(plen);
+      if (fread(payload.data(), 1, plen, f) != plen) return false;
+      if (crc32(0L, payload.data(), payload.size()) != crc) continue;  // skip
+      if (comp == 1) {
+        // decompressed size unknown; grow geometrically
+        uLongf cap = plen * 4 + 64;
+        for (;;) {
+          chunk.resize(cap);
+          uLongf dst = cap;
+          int rc = uncompress(chunk.data(), &dst, payload.data(), plen);
+          if (rc == Z_OK) {
+            chunk.resize(dst);
+            break;
+          }
+          if (rc != Z_BUF_ERROR) return false;
+          cap *= 2;
+        }
+      } else {
+        chunk = std::move(payload);
+      }
+      pos = 0;
+      return true;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_writer_open(const char* path, int compressor) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  Writer* w = new Writer();
+  w->f = f;
+  w->compressor = compressor;
+  return w;
+}
+
+int rio_writer_write(void* h, const uint8_t* data, uint32_t len) {
+  Writer* w = static_cast<Writer*>(h);
+  w->append_u32(&w->buf, len);
+  w->buf.insert(w->buf.end(), data, data + len);
+  w->num_records++;
+  if (w->buf.size() >= kChunkFlushBytes) return w->flush_chunk();
+  return 0;
+}
+
+int rio_writer_close(void* h) {
+  Writer* w = static_cast<Writer*>(h);
+  int rc = w->flush_chunk();
+  fclose(w->f);
+  delete w;
+  return rc;
+}
+
+void* rio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  Scanner* s = new Scanner();
+  s->f = f;
+  return s;
+}
+
+// returns 1 and sets (*data, *len) on success; 0 on EOF; -1 on error.
+int rio_scanner_next(void* h, const uint8_t** data, uint32_t* len) {
+  Scanner* s = static_cast<Scanner*>(h);
+  for (;;) {
+    if (s->pos + 4 <= s->chunk.size()) {
+      uint32_t rlen = uint32_t(s->chunk[s->pos]) |
+                      uint32_t(s->chunk[s->pos + 1]) << 8 |
+                      uint32_t(s->chunk[s->pos + 2]) << 16 |
+                      uint32_t(s->chunk[s->pos + 3]) << 24;
+      s->pos += 4;
+      if (s->pos + rlen > s->chunk.size()) return -1;
+      s->last_record.assign(s->chunk.begin() + s->pos,
+                            s->chunk.begin() + s->pos + rlen);
+      s->pos += rlen;
+      *data = s->last_record.data();
+      *len = rlen;
+      return 1;
+    }
+    if (!s->next_chunk()) return 0;
+  }
+}
+
+void rio_scanner_close(void* h) {
+  Scanner* s = static_cast<Scanner*>(h);
+  fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

@@ -115,9 +115,14 @@ class Executor:
             tuple(fetch_names),
             id(scope),
         )
+        from paddle_tpu import profiler as _profiler
+
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
-            entry = self._compile(program, compiled, feed_names, fetch_names, scope)
+            with _profiler.record_event("executor.compile"):
+                entry = self._compile(
+                    program, compiled, feed_names, fetch_names, scope
+                )
             if use_program_cache:
                 self._cache[key] = entry
         fn, lowered = entry
@@ -139,7 +144,8 @@ class Executor:
         if compiled is not None:
             state, feed_vals = compiled.shard_inputs(state, feed_vals)
 
-        fetches, new_state = fn(state, feed_vals, rng)
+        with _profiler.record_event("executor.run_step"):
+            fetches, new_state = fn(state, feed_vals, rng)
         for n, v in new_state.items():
             scope.set(n, v)
 

@@ -81,9 +81,12 @@ def _sdpa(ins, attrs, rng=None):
                             preferred_element_type=jnp.float32) * scale
         if bias is not None:
             scores = scores + bias.astype(scores.dtype)
-        attn = jax.nn.softmax(scores, axis=-1)
+        # softmax reduction in f32, then drop to the value dtype so the
+        # materialized attention matrix (the big HBM buffer) is bf16 under
+        # AMP and the dropout where() streams half the bytes
+        attn = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
         if training_dropout:
             keep = jax.random.bernoulli(rng, 1.0 - p_drop, jnp.shape(attn))
-            attn = jnp.where(keep, attn / (1.0 - p_drop), 0.0)
-        out = jnp.einsum("bhqk,bhkd->bhqd", attn.astype(v.dtype), v)
+            attn = jnp.where(keep, attn / (1.0 - p_drop), 0.0).astype(v.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
     return {"Out": [out.astype(q.dtype)]}

@@ -1,0 +1,95 @@
+"""Profiler front end (reference: python/paddle/fluid/profiler.py).
+
+Host spans go to the native C++ profiler (csrc/profiler.cc -> chrome trace,
+the analog of RecordEvent + tools/timeline.py). Device-side profiling is
+delegated to jax.profiler (XLA xplane -> TensorBoard/perfetto), replacing
+the reference's CUPTI DeviceTracer (reference: platform/device_tracer.cc).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+# Fast-path flag so per-step record_event calls cost one attribute check
+# when profiling is off.
+_host_enabled = False
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: Optional[str] = None,
+             profile_path: str = "/tmp/profile", with_xplane: bool = False):
+    """Context manager enabling host-span + device profiling.
+
+    Writes <profile_path>.json (chrome trace of host spans). With
+    ``with_xplane=True`` also captures the XLA device trace to
+    <profile_path>_xplane/ via jax.profiler (can hang on tunneled/remote
+    TPU backends, hence opt-in).
+    """
+    global _host_enabled
+    from paddle_tpu import native
+
+    use_native = native.available()
+    if use_native:
+        native.profiler_enable()
+        _host_enabled = True
+    jax_trace_dir = profile_path + "_xplane"
+    jax_started = False
+    if with_xplane:
+        try:
+            import jax
+
+            jax.profiler.start_trace(jax_trace_dir)
+            jax_started = True
+        except Exception:
+            pass
+    try:
+        yield
+    finally:
+        if jax_started:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        if use_native:
+            native.profiler_disable()
+            _host_enabled = False
+            native.profiler_dump(profile_path + ".json")
+
+
+@contextlib.contextmanager
+def record_event(name: str):
+    """RAII host span (reference: platform/profiler.h:81 RecordEvent)."""
+    if not _host_enabled:
+        yield
+        return
+    from paddle_tpu import native
+
+    native.profiler_begin(name)
+    try:
+        yield
+    finally:
+        native.profiler_end()
+
+
+def start_profiler(state: str = "All"):
+    global _host_enabled
+    from paddle_tpu import native
+
+    if native.available():
+        native.profiler_enable()
+        _host_enabled = True
+
+
+def stop_profiler(sorted_key: Optional[str] = None,
+                  profile_path: str = "/tmp/profile"):
+    global _host_enabled
+    from paddle_tpu import native
+
+    if native.available():
+        native.profiler_disable()
+        _host_enabled = False
+        native.profiler_dump(profile_path + ".json")
