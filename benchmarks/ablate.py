@@ -1,0 +1,72 @@
+"""Step-time ablations for the Transformer bench config (manual TPU tool)."""
+
+import sys
+import time
+
+import numpy as np
+
+
+def run_config(label, dropout, vocab=10000, batch=32, seq=256, amp=True,
+               is_test=False, use_pallas=True, steps=10):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(
+        src_vocab_size=vocab, trg_vocab_size=vocab, max_length=seq + 2,
+        d_model=512, d_inner=2048, n_head=8, n_layer=6, dropout=dropout,
+    )
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        model = T.build(cfg, is_test=is_test)
+        if not use_pallas:
+            # must happen BEFORE minimize(): grad ops copy the forward
+            # attrs at append_backward time
+            for block in main_prog.blocks:
+                for op in block.ops:
+                    if op.type == "scaled_dot_product_attention":
+                        op.attrs["use_pallas"] = False
+        if not is_test:
+            fluid.optimizer.Adam(1e-4).minimize(model["loss"])
+    main_prog._amp = amp
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feeds = [
+        {k: jax.device_put(v) for k, v in
+         T.make_batch(cfg, batch, seq, seq, seed=s).items()}
+        for s in range(2)
+    ]
+    t0 = time.time()
+    exe.run(main_prog, feed=feeds[0], fetch_list=[model["loss"]], scope=scope)
+    compile_s = time.time() - t0
+    for f in feeds:
+        exe.run(main_prog, feed=f, fetch_list=[model["loss"]], scope=scope)
+    t0 = time.time()
+    out = None
+    for i in range(steps):
+        out = exe.run(main_prog, feed=feeds[i % 2], fetch_list=[model["loss"]],
+                      scope=scope, return_numpy=False)
+    _ = float(np.asarray(out[0]))
+    dt = (time.time() - t0) / steps
+    print(f"{label:40s} step={dt*1000:7.1f}ms  compile={compile_s:6.1f}s",
+          flush=True)
+    return dt
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "base"):
+        run_config("train base (drop 0.1, pallas)", 0.1)
+    if which in ("all", "nodrop"):
+        run_config("train no-dropout", 0.0)
+    if which in ("all", "dense"):
+        run_config("train dense attn", 0.1, use_pallas=False)
+    if which in ("all", "fwd"):
+        run_config("forward only (is_test)", 0.0, is_test=True)
+    if which in ("all", "vocab"):
+        run_config("train small vocab 1k", 0.1, vocab=1000)
+    if which in ("all", "noamp"):
+        run_config("train f32 (no AMP)", 0.1, amp=False)
+    if which in ("all", "b64"):
+        run_config("train batch 64", 0.1, batch=64)

@@ -50,7 +50,11 @@ class CompiledProgram:
     """Wraps a Program with a parallel execution plan
     (reference: compiler.py:49)."""
 
+    _uid_counter = 0
+
     def __init__(self, program: Program):
+        CompiledProgram._uid_counter += 1
+        self._uid = CompiledProgram._uid_counter
         self.program = program
         self._mesh: Optional[Mesh] = None
         self._data_parallel = False

@@ -56,29 +56,29 @@ def make_grad_compute(fwd: OpDef):
                 if x is not None and _floatp(x):
                     diff_keys.append((s, i))
 
-        # Probe the forward once for output slot arity (traced; XLA CSEs it).
-        probe = fwd.compute({s: list(v) for s, v in fwd_ins.items()},
-                            fwd_attrs, **rng_kwargs)
-        arity = {o: len(probe.get(o, [])) for o in out_slots}
-
+        # vjp over a pytree-valued forward: slot arity falls out of the
+        # returned structure, so the forward is traced exactly once here
+        # (the round-1 arity "probe" doubled trace size and compile time).
         def fwd_fn(diff_vals):
             merged = {s: list(v) for s, v in fwd_ins.items()}
             for (s, i), v in zip(diff_keys, diff_vals):
                 merged[s][i] = v
             outs = fwd.compute(merged, fwd_attrs, **rng_kwargs)
-            return [y for o in out_slots for y in outs.get(o, [])]
+            return {o: [y for y in outs.get(o, [])] for o in out_slots}
 
         primals = [fwd_ins[s][i] for (s, i) in diff_keys]
-        out_flat, vjp_fn = jax.vjp(fwd_fn, primals)
+        out_tree, vjp_fn = jax.vjp(fwd_fn, primals)
 
-        # Cotangents aligned with out_flat; zeros where the program did not
+        # Cotangents mirroring out_tree; zeros where the program did not
         # provide a gradient for an output.
-        cotangents = []
-        k = 0
+        cotangents = {}
         for o in out_slots:
             gslot = ins.get(GRAD_SLOT_PREFIX + o, [])
-            for i in range(arity[o]):
-                y = out_flat[k]
+            cots = []
+            for i, y in enumerate(out_tree[o]):
+                if y is None:
+                    cots.append(None)
+                    continue
                 g = gslot[i] if i < len(gslot) else None
                 if g is None:
                     g = jnp.zeros(jnp.shape(y), jnp.result_type(y))
@@ -86,8 +86,8 @@ def make_grad_compute(fwd: OpDef):
                     g = jnp.asarray(g, jnp.result_type(y))
                     if jnp.shape(g) != jnp.shape(y):
                         g = jnp.broadcast_to(g, jnp.shape(y))
-                cotangents.append(g)
-                k += 1
+                cots.append(g)
+            cotangents[o] = cots
 
         (grads,) = vjp_fn(cotangents)
 

@@ -33,8 +33,12 @@ class Scope:
     values are accepted and converted lazily.
     """
 
+    _uid_counter = 0
+
     def __init__(self):
         self._vars: Dict[str, Any] = {}
+        Scope._uid_counter += 1
+        self._uid = Scope._uid_counter
 
     def set(self, name: str, value):
         self._vars[name] = value
@@ -107,13 +111,13 @@ class Executor:
             (k, tuple(np.shape(v)), str(jnp.result_type(v))) for k, v in feed_vals.items()
         )
         key = (
-            id(program),
+            program._uid,
             program.version,
             getattr(program, "_amp", False),
-            id(compiled) if compiled is not None else 0,
+            compiled._uid if compiled is not None else 0,
             sig,
             tuple(fetch_names),
-            id(scope),
+            scope._uid,
         )
         from paddle_tpu import profiler as _profiler
 
@@ -145,7 +149,19 @@ class Executor:
             state, feed_vals = compiled.shard_inputs(state, feed_vals)
 
         with _profiler.record_event("executor.run_step"):
-            fetches, new_state = fn(state, feed_vals, rng)
+            try:
+                fetches, new_state = fn(state, feed_vals, rng)
+            except Exception:
+                # State buffers were donated to the failed call; any that
+                # were actually consumed are now deleted. Drop those scope
+                # entries so later use fails loudly with "not initialized"
+                # instead of a deleted-buffer crash (compile-time failures
+                # leave the state untouched).
+                for n in lowered.state_in_names:
+                    v = scope.find_var(n)
+                    if isinstance(v, jax.Array) and v.is_deleted():
+                        scope.drop(n)
+                raise
         for n, v in new_state.items():
             scope.set(n, v)
 

@@ -413,9 +413,21 @@ class Block:
                     )
                     v.shape = shape
                     v.dtype = np.dtype(r.dtype).name
-        except Exception:
-            # Shape inference is advisory; lowering uses real shapes.
-            pass
+        except Exception as e:
+            # Shape inference is advisory (lowering uses real shapes), but a
+            # silent no-op hides broken kernels/attrs until lowering; log
+            # once per (op_type, error) so build-time breakage is visible.
+            global _SHAPE_INFER_FAILURES
+            sig = (op.type, type(e).__name__)
+            if sig not in _SHAPE_INFER_FAILURES:
+                _SHAPE_INFER_FAILURES.add(sig)
+                import logging
+
+                logging.getLogger("paddle_tpu").warning(
+                    "shape inference failed for op '%s': %s: %s "
+                    "(advisory; real shapes resolved at lowering)",
+                    op.type, type(e).__name__, e,
+                )
 
     def to_proto(self) -> pb.BlockDesc:
         d = pb.BlockDesc(idx=self.idx, parent_idx=self.parent_idx)
@@ -432,12 +444,21 @@ class Block:
         return "\n".join(lines)
 
 
+_SHAPE_INFER_FAILURES: set = set()
+
+
 class Program:
     """A list of blocks; block 0 is global (reference: framework.py:2705)."""
+
+    _uid_counter = 0
 
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0, -1)]
         self.current_block_idx = 0
+        # Monotonic global uid: executor cache keys use this instead of
+        # id() (id reuse after GC could alias a stale compiled entry).
+        Program._uid_counter += 1
+        self._uid = Program._uid_counter
         self._version = 0
         self.random_seed: Optional[int] = None
         # bf16 mixed-precision execution flag (see paddle_tpu/amp.py)

@@ -48,11 +48,19 @@ def save_vars(
         vars = _collect(program, predicate or _is_persistable)
     os.makedirs(dirname, exist_ok=True)
     arrays: Dict[str, np.ndarray] = {}
+    missing = []
     for v in vars:
         val = scope.find_var(v.name)
         if val is None:
+            missing.append(v.name)
             continue
         arrays[v.name] = np.asarray(val)
+    if missing:
+        raise RuntimeError(
+            f"save_vars: {len(missing)} requested variables are not "
+            f"initialized in the scope (e.g. {missing[:5]}); run the "
+            f"startup program first"
+        )
     if filename is None:
         filename = _PARAMS_FILE
     np.savez(os.path.join(dirname, filename), **arrays)
@@ -78,9 +86,18 @@ def load_vars(
         path = path + ".npz"
     with np.load(path) as data:
         names = set(data.files)
+        missing = [v.name for v in vars if v.name not in names]
+        if missing:
+            # A partially matching checkpoint would leave the rest of the
+            # model at random init and silently train/eval garbage
+            # (reference load_persistables raises likewise).
+            raise RuntimeError(
+                f"checkpoint '{path}' is missing {len(missing)} of "
+                f"{len(list(vars))} requested variables "
+                f"(e.g. {missing[:5]}); refusing to partially load"
+            )
         for v in vars:
-            if v.name in names:
-                scope.set(v.name, np.asarray(data[v.name]))
+            scope.set(v.name, np.asarray(data[v.name]))
 
 
 def save_persistables(executor, dirname, main_program=None, filename=None):

@@ -55,10 +55,11 @@ def _attn_bias(ins, attrs):
 def _sdpa(ins, attrs, rng=None):
     """Fused attention: Q,K,V [b, h, t, dh] + optional additive Bias.
 
-    With no attention dropout this routes to the Pallas flash-attention
-    kernel on TPU (paddle_tpu/parallel/flash_attention.py); with dropout
-    (or off-TPU, or in the numeric-grad harness) it uses the jnp
-    composition, which XLA fuses.
+    On TPU this routes to the Pallas flash-attention kernel
+    (paddle_tpu/parallel/flash_attention.py), including training-time
+    attention dropout, which runs inside the kernel from a per-step seed.
+    Off-TPU (or in the numeric-grad harness) it uses the jnp composition,
+    which XLA fuses.
     """
     q, k, v = _x(ins, "Q"), _x(ins, "K"), _x(ins, "V")
     bias = _x(ins, "Bias")
@@ -70,12 +71,20 @@ def _sdpa(ins, attrs, rng=None):
     use_pallas = (
         jax.default_backend() == "tpu"
         and attrs.get("use_pallas", True)
-        and not training_dropout
     )
     if use_pallas:
         from paddle_tpu.parallel.flash_attention import flash_attention
 
-        out = flash_attention(q, k, v, bias=bias, scale=scale)
+        seed = None
+        drop = 0.0
+        if training_dropout:
+            # Attention dropout runs inside the kernel (regenerated from
+            # this seed in the backward) — the dense fallback round 1 took
+            # here materialized the t x t score matrix in HBM.
+            drop = float(p_drop)
+            seed = jax.random.randint(rng, (), 0, 2**31 - 1, dtype=jnp.int32)
+        out = flash_attention(q, k, v, bias=bias, seed=seed, scale=scale,
+                              p_drop=drop)
     else:
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                             preferred_element_type=jnp.float32) * scale

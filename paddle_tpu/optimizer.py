@@ -378,11 +378,22 @@ class ExponentialMovingAverage:
         self._name = name or "ema"
         self._shadows: List[Tuple[Variable, Variable]] = []
         self._backup: Dict[str, object] = {}
+        self._step_var = None
 
     def update(self):
         from paddle_tpu.layers import nn, tensor
 
         prog = default_main_program()
+        block = prog.global_block()
+        # Step counter for zero-debiasing: shadows start at 0, so the raw
+        # EMA is biased low by (1 - decay^t) (reference: optimizer.py:2292).
+        self._step_var = tensor.create_global_var(
+            shape=[1], value=0.0, dtype="float32", persistable=True,
+            name=unique_name.generate(f"{self._name}_step"),
+        )
+        bumped = nn.scale(block.var(self._step_var.name), scale=1.0, bias=1.0)
+        block.append_op("assign", inputs={"X": bumped},
+                        outputs={"Out": self._step_var.name})
         for p in prog.all_parameters():
             if not p.trainable:
                 continue
@@ -391,7 +402,6 @@ class ExponentialMovingAverage:
                 persistable=True,
                 name=unique_name.generate(f"{self._name}_{p.name}"),
             )
-            block = prog.global_block()
             # shadow = decay*shadow + (1-decay)*param
             scaled = nn.scale(block.var(shadow.name), scale=self._decay)
             contrib = nn.scale(block.var(p.name), scale=1.0 - self._decay)
@@ -413,12 +423,19 @@ class ExponentialMovingAverage:
         from paddle_tpu.executor import global_scope
 
         scope = global_scope()
+        # zero-debias: shadow / (1 - decay^t)
+        correction = 1.0
+        if self._step_var is not None:
+            sv = scope.find_var(self._step_var.name)
+            t = float(np.asarray(sv).reshape(-1)[0]) if sv is not None else 0.0
+            if t > 0:
+                correction = 1.0 / (1.0 - self._decay ** t)
         for p, shadow in self._shadows:
             if need_restore:
                 self._backup[p.name] = np.asarray(scope.find_var(p.name))
             sv = scope.find_var(shadow.name)
             if sv is not None:
-                scope.set(p.name, np.asarray(sv))
+                scope.set(p.name, np.asarray(sv) * correction)
 
         @contextlib.contextmanager
         def _guard():

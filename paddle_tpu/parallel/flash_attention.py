@@ -1,16 +1,25 @@
-"""Pallas TPU attention kernel.
+"""Pallas TPU flash attention (FlashAttention-2 style).
 
 The hot op of the transformer family (SURVEY.md section 7: "pallas kernels
-for the hot ops"). Forward runs as a Pallas kernel that keeps the score
-matrix for one query block in VMEM — scores never round-trip to HBM, the
-two matmuls hit the MXU back-to-back. Backward recomputes through the jnp
-composition under custom_vjp (flash-style rematerialization: trade FLOPs
-for HBM, XLA fuses the recompute).
+for the hot ops"). Both directions are K-blocked with online softmax: the
+score matrix never exists at full [tq, tk] size in any memory space, so
+VMEM use is O(block^2) and HBM traffic is O(t) regardless of context
+length — the property the long-context/ring-attention path builds on.
 
-Layout: q, k, v are [b, h, t, dh]; bias is additive [b, 1|h, tq, tk].
-Block size over queries is 256 (fits (256, t) f32 scores in VMEM for the
-sequence lengths the benchmarks use; lane dim dh is zero-padded to 128 by
-Mosaic automatically).
+- Forward: grid (b*h, tq/bq, tk/bk); per q-block running (m, l, acc)
+  carried in VMEM scratch across the k-block loop; emits the output and
+  the logsumexp rows needed by the backward.
+- Backward: recompute p = exp(s - lse) per block (no stored attention
+  matrix). dq in one kernel (k-blocks inner), dk/dv in a second kernel
+  (q-blocks inner), using the standard delta = rowsum(do * o) reduction.
+- Attention dropout runs inside the kernels via the TPU PRNG: the mask
+  for score block (bh, jq, jk) is regenerated from (seed, bh, jq, jk) in
+  every kernel, so forward and backward see identical masks and nothing
+  is stored.
+
+Layout: q, k, v are [b, h, t, dh]; bias is additive [b, 1|h, 1|tq, tk].
+Falls back to the dense jnp composition off-TPU or when the sequence
+lengths don't divide the block sizes.
 """
 
 from __future__ import annotations
@@ -22,12 +31,46 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_Q_BLOCK = 256
+DEFAULT_K_BLOCK = 256
+_NEG_INF = -1e30
+
+# Test hook: run the Pallas kernels in interpreter mode on CPU so the
+# blocked online-softmax path itself is exercised by the pytest suite
+# (the reference-composition fallback would otherwise shadow it off-TPU).
+_INTERPRET = False
 
 
-def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale):
-    # q_ref: [1, Bq, dh]; k_ref/v_ref: [1, t, dh]; bias_ref: [1, Bq, t]
+def _block_seed(seed, i, j, kk):
+    """Mix (seed, batch-head, q-block, k-block) into one scalar for the
+    per-core PRNG (the multi-operand prng_seed form doesn't lower on all
+    backends). int32 wraparound is the hash."""
+    s = seed
+    for x in (i, j, kk):
+        s = (s * jnp.int32(1000003)) ^ jnp.int32(x)
+    return s
+
+
+def _dropout_mask(p_keep: float, shape):
+    """Per-block keep mask from the already-seeded TPU PRNG, scaled by
+    1/p_keep (inverted dropout)."""
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    thresh = jnp.uint32(int(p_keep * float(2**32 - 1)))
+    return (bits < thresh).astype(jnp.float32) * (1.0 / p_keep)
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, nk, p_drop):
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
     q = q_ref[0]
     k = k_ref[0]
     v = v_ref[0]
@@ -37,105 +80,407 @@ def _attn_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, *, scale):
     ) * scale
     if bias_ref is not None:
         s = s + bias_ref[0].astype(jnp.float32)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jax.lax.dot_general(
+
+    m_prev = m_scr[:, :1]
+    l_prev = l_scr[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+
+    if p_drop > 0.0:
+        pltpu.prng_seed(
+            _block_seed(seed_ref[0], pl.program_id(0), pl.program_id(1), kk))
+        p = p * _dropout_mask(1.0 - p_drop, p.shape)
+
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
         p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    o_ref[0] = (o / l).astype(o_ref.dtype)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kk == nk - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l_scr[:, :1])
 
 
-def _reference_attention(q, k, v, bias, scale):
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+               delta_ref, dq_ref, dq_scr, *, scale, nk, p_drop):
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]        # [bq, 1] f32
+    delta = delta_ref[0]    # [bq, 1] f32
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+    p = jnp.exp(s - lse)  # post-softmax probabilities, recomputed
+
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if p_drop > 0.0:
+        pltpu.prng_seed(
+            _block_seed(seed_ref[0], pl.program_id(0), pl.program_id(1), kk))
+        dp = dp * _dropout_mask(1.0 - p_drop, dp.shape)
+    ds = p * (dp - delta) * scale
+    dq_scr[:] += jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kk == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                scale, nq, p_drop):
+    jq = pl.program_id(2)
+
+    @pl.when(jq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]      # [bq, 1]
+    delta = delta_ref[0]  # [bq, 1]
+
+    # Work in the transposed orientation: s_t[kk, qq]
+    s_t = jax.lax.dot_general(
+        k, q, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if bias_ref is not None:
+        s_t = s_t + jnp.transpose(bias_ref[0].astype(jnp.float32))
+    p_t = jnp.exp(s_t - jnp.transpose(lse))  # [bk, bq]
+
+    if p_drop > 0.0:
+        # Same (bh, q-block, k-block) stream as the forward, generated in
+        # the forward's (bq, bk) orientation then transposed.
+        pltpu.prng_seed(
+            _block_seed(seed_ref[0], pl.program_id(0), jq, pl.program_id(1)))
+        drop_t = jnp.transpose(
+            _dropout_mask(1.0 - p_drop, (p_t.shape[1], p_t.shape[0]))
+        )
+        pd_t = p_t * drop_t
+    else:
+        pd_t = p_t
+
+    dv_scr[:] += jax.lax.dot_general(
+        pd_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp_t = jax.lax.dot_general(
+        v, do, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if p_drop > 0.0:
+        dp_t = dp_t * drop_t
+    ds_t = p_t * (dp_t - jnp.transpose(delta)) * scale
+    dk_scr[:] += jax.lax.dot_general(
+        ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(jq == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bias_spec(bias, b, h, bq, bk, *, transposed=False):
+    """BlockSpec for the stored-rank bias [b, 1|h, 1|tq, tk], reshaped to
+    (b or b*h, 1|tq, tk). Index maps take grid (i=bh, j=qblk, kk=kblk);
+    when ``transposed`` the grid is (i, kk, j)."""
+    hb, tq_b = bias.shape[1], bias.shape[2]
+    tk = bias.shape[3]
+    if hb == 1:
+        arr = bias.reshape(bias.shape[0], tq_b, tk)
+        bsel = lambda i: i // h
+    else:
+        arr = bias.reshape(bias.shape[0] * hb, tq_b, tk)
+        bsel = lambda i: i
+    qdim = 1 if tq_b == 1 else bq
+    if transposed:
+        if tq_b == 1:
+            idx = lambda i, kk, j, *_: (bsel(i), 0, kk)
+        else:
+            idx = lambda i, kk, j, *_: (bsel(i), j, kk)
+    else:
+        if tq_b == 1:
+            idx = lambda i, j, kk, *_: (bsel(i), 0, kk)
+        else:
+            idx = lambda i, j, kk, *_: (bsel(i), j, kk)
+    return arr, pl.BlockSpec((1, qdim, bk), idx)
+
+
+def _reference_attention(q, k, v, bias, scale, p_drop=0.0, seed=None):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias.astype(s.dtype)
     p = jax.nn.softmax(s, axis=-1)
+    if p_drop > 0.0:
+        key = jax.random.PRNGKey(0 if seed is None else jnp.asarray(seed))
+        keep = jax.random.bernoulli(key, 1.0 - p_drop, p.shape)
+        p = jnp.where(keep, p / (1.0 - p_drop), 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash_attention(q, k, v, bias=None, scale: Optional[float] = None,
-                    q_block: int = DEFAULT_Q_BLOCK):
-    return _flash_fwd(q, k, v, bias, scale, q_block)[0]
+def _seed_cotangent(seed):
+    """Symbolic-zero cotangent for the integer seed operand."""
+    if seed is None:
+        return None
+    import numpy as _np
+
+    return _np.zeros(_np.shape(seed), jax.dtypes.float0)
 
 
-def _flash_fwd(q, k, v, bias, scale, q_block):
+def _use_pallas(tq, tk, bq, bk):
+    return (
+        (jax.default_backend() == "tpu" or _INTERPRET)
+        and tq % bq == 0
+        and tk % bk == 0
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def flash_attention(q, k, v, bias=None, seed=None,
+                    scale: Optional[float] = None, p_drop: float = 0.0,
+                    q_block: int = DEFAULT_Q_BLOCK,
+                    k_block: int = DEFAULT_K_BLOCK):
+    """o = dropout(softmax(q k^T * scale + bias)) v.
+
+    ``seed``: int32 scalar array driving attention dropout (ignored when
+    p_drop == 0).
+
+    ``bias`` is treated as mask plumbing, NOT a trainable input: on the
+    Pallas path its cotangent is zeros (computing it would materialize a
+    t x t gradient, defeating the kernel). Use the dense composition if a
+    learnable additive bias must receive gradients.
+    """
+    out, _ = _flash_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block)
+    return out
+
+
+def _flash_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block):
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     b, h, tq, dh = q.shape
     tk = k.shape[2]
     bq = min(q_block, tq)
-    if tq % bq != 0 or jax.default_backend() != "tpu":
-        out = _reference_attention(q, k, v, bias, scale)
-        return out, (q, k, v, bias)
+    bk = min(k_block, tk)
+    if not _use_pallas(tq, tk, bq, bk):
+        out = _reference_attention(q, k, v, bias, scale, p_drop,
+                                   seed if p_drop > 0.0 else None)
+        return out, (q, k, v, bias, seed, None, None)
 
     bh = b * h
+    nq, nk = tq // bq, tk // bk
     q_r = q.reshape(bh, tq, dh)
     k_r = k.reshape(bh, tk, dh)
     v_r = v.reshape(bh, tk, dh)
-    nq = tq // bq
 
     in_specs = [
-        pl.BlockSpec((1, bq, dh), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, tk, dh), lambda i, j: (i, 0, 0)),
-        pl.BlockSpec((1, tk, dh), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, bq, dh), lambda i, j, kk, *_: (i, j, 0)),
+        pl.BlockSpec((1, bk, dh), lambda i, j, kk, *_: (i, kk, 0)),
+        pl.BlockSpec((1, bk, dh), lambda i, j, kk, *_: (i, kk, 0)),
     ]
     args = [q_r, k_r, v_r]
     if bias is not None:
-        # Never materialize a broadcast bias: keep the stored rank
-        # ([b,1,1,tk] pad rows or [b,1|h,tq,tk] causal) and index size-1
-        # dims with a constant 0 block; the kernel broadcasts in VMEM.
-        hb, tq_b = bias.shape[1], bias.shape[2]
-        if hb == 1:
-            bias_bh = bias.reshape(b, tq_b, tk)
-            if tq_b == 1:
-                spec = pl.BlockSpec((1, 1, tk), lambda i, j, h=h: (i // h, 0, 0))
-            else:
-                spec = pl.BlockSpec((1, bq, tk), lambda i, j, h=h: (i // h, j, 0))
-        else:
-            bias_bh = bias.reshape(bh, tq_b, tk)
-            if tq_b == 1:
-                spec = pl.BlockSpec((1, 1, tk), lambda i, j: (i, 0, 0))
-            else:
-                spec = pl.BlockSpec((1, bq, tk), lambda i, j: (i, j, 0))
+        bias_arr, spec = _bias_spec(bias, b, h, bq, bk)
         in_specs.append(spec)
-        args.append(bias_bh)
-        kernel = functools.partial(_attn_fwd_kernel, scale=scale)
+        args.append(bias_arr)
+        kernel = functools.partial(_fwd_kernel, scale=scale, nk=nk,
+                                   p_drop=p_drop)
     else:
         kernel = functools.partial(
-            lambda qr, kr, vr, orf, scale: _attn_fwd_kernel(
-                qr, kr, vr, None, orf, scale=scale),
-            scale=scale,
+            lambda sr, qr, kr, vr, orf, lr, ms, ls, accs, **kw: _fwd_kernel(
+                sr, qr, kr, vr, None, orf, lr, ms, ls, accs, **kw),
+            scale=scale, nk=nk, p_drop=p_drop,
         )
 
-    out = pl.pallas_call(
+    seed_arr = jnp.zeros((1,), jnp.int32) if seed is None else (
+        jnp.asarray(seed, jnp.int32).reshape((1,))
+    )
+
+    out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nq),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, bq, dh), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, dh), q.dtype),
-    )(*args)
-    return out.reshape(b, h, tq, dh), (q, k, v, bias)
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nq, nk),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, bq, dh), lambda i, j, kk, *_: (i, j, 0)),
+                pl.BlockSpec((1, bq, 1), lambda i, j, kk, *_: (i, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, dh), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, dh), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(seed_arr, *args)
+    return out.reshape(b, h, tq, dh), (q, k, v, bias, seed, out, lse)
 
 
-def _flash_bwd(scale, q_block, res, g):
-    q, k, v, bias = res
+def _flash_bwd(scale, p_drop, q_block, k_block, res, g):
+    q, k, v, bias, seed, out, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    b, h, tq, dh = q.shape
+    tk = k.shape[2]
+    bq = min(q_block, tq)
+    bk = min(k_block, tk)
 
-    def f(q, k, v, bias):
-        return _reference_attention(q, k, v, bias, scale)
+    if out is None:  # forward took the dense path; mirror it
+        def f(q, k, v, bias):
+            return _reference_attention(q, k, v, bias, scale, p_drop,
+                                        seed if p_drop > 0.0 else None)
 
-    if bias is None:
-        _, vjp = jax.vjp(lambda a, b, c: f(a, b, c, None), q, k, v)
-        dq, dk, dv = vjp(g)
-        return dq, dk, dv, None
-    _, vjp = jax.vjp(f, q, k, v, bias)
-    dq, dk, dv, dbias = vjp(g)
-    return dq, dk, dv, dbias
+        if bias is None:
+            _, vjp = jax.vjp(lambda a, bb, c: f(a, bb, c, None), q, k, v)
+            dq, dk, dv = vjp(g)
+            return dq, dk, dv, None, _seed_cotangent(seed)
+        _, vjp = jax.vjp(f, q, k, v, bias)
+        dq, dk, dv, dbias = vjp(g)
+        return dq, dk, dv, dbias, _seed_cotangent(seed)
+
+    bh = b * h
+    nq, nk = tq // bq, tk // bk
+    q_r = q.reshape(bh, tq, dh)
+    k_r = k.reshape(bh, tk, dh)
+    v_r = v.reshape(bh, tk, dh)
+    do_r = g.reshape(bh, tq, dh)
+    out_r = out  # already [bh, tq, dh]
+    delta = jnp.sum(do_r.astype(jnp.float32) * out_r.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [bh, tq, 1]
+
+    seed_arr = jnp.zeros((1,), jnp.int32) if seed is None else (
+        jnp.asarray(seed, jnp.int32).reshape((1,))
+    )
+
+    # --- dq: grid (bh, nq, nk), k-blocks inner ---
+    dq_specs = [
+        pl.BlockSpec((1, bq, dh), lambda i, j, kk, *_: (i, j, 0)),   # q
+        pl.BlockSpec((1, bk, dh), lambda i, j, kk, *_: (i, kk, 0)),  # k
+        pl.BlockSpec((1, bk, dh), lambda i, j, kk, *_: (i, kk, 0)),  # v
+    ]
+    dq_args = [q_r, k_r, v_r]
+    if bias is not None:
+        bias_arr, spec = _bias_spec(bias, b, h, bq, bk)
+        dq_specs.append(spec)
+        dq_args.append(bias_arr)
+        dq_kernel = functools.partial(_dq_kernel, scale=scale, nk=nk,
+                                      p_drop=p_drop)
+    else:
+        dq_kernel = functools.partial(
+            lambda sr, qr, kr, vr, dor, lr, der, dqr, dqs, **kw: _dq_kernel(
+                sr, qr, kr, vr, None, dor, lr, der, dqr, dqs, **kw),
+            scale=scale, nk=nk, p_drop=p_drop,
+        )
+    dq_specs += [
+        pl.BlockSpec((1, bq, dh), lambda i, j, kk, *_: (i, j, 0)),  # do
+        pl.BlockSpec((1, bq, 1), lambda i, j, kk, *_: (i, j, 0)),   # lse
+        pl.BlockSpec((1, bq, 1), lambda i, j, kk, *_: (i, j, 0)),   # delta
+    ]
+    dq_args += [do_r, lse, delta]
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nq, nk),
+            in_specs=dq_specs,
+            out_specs=pl.BlockSpec((1, bq, dh), lambda i, j, kk, *_: (i, j, 0)),
+            scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, dh), q.dtype),
+        interpret=_INTERPRET,
+    )(seed_arr, *dq_args)
+
+    # --- dk/dv: grid (bh, nk, nq), q-blocks inner ---
+    dkv_specs = [
+        pl.BlockSpec((1, bq, dh), lambda i, kk, j, *_: (i, j, 0)),   # q
+        pl.BlockSpec((1, bk, dh), lambda i, kk, j, *_: (i, kk, 0)),  # k
+        pl.BlockSpec((1, bk, dh), lambda i, kk, j, *_: (i, kk, 0)),  # v
+    ]
+    dkv_args = [q_r, k_r, v_r]
+    if bias is not None:
+        bias_arr, spec = _bias_spec(bias, b, h, bq, bk, transposed=True)
+        dkv_specs.append(spec)
+        dkv_args.append(bias_arr)
+        dkv_kernel = functools.partial(_dkv_kernel, scale=scale, nq=nq,
+                                       p_drop=p_drop)
+    else:
+        dkv_kernel = functools.partial(
+            lambda sr, qr, kr, vr, dor, lr, der, dkr, dvr, dks, dvs, **kw:
+                _dkv_kernel(sr, qr, kr, vr, None, dor, lr, der, dkr, dvr,
+                            dks, dvs, **kw),
+            scale=scale, nq=nq, p_drop=p_drop,
+        )
+    dkv_specs += [
+        pl.BlockSpec((1, bq, dh), lambda i, kk, j, *_: (i, j, 0)),  # do
+        pl.BlockSpec((1, bq, 1), lambda i, kk, j, *_: (i, j, 0)),   # lse
+        pl.BlockSpec((1, bq, 1), lambda i, kk, j, *_: (i, j, 0)),   # delta
+    ]
+    dkv_args += [do_r, lse, delta]
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nk, nq),
+            in_specs=dkv_specs,
+            out_specs=[
+                pl.BlockSpec((1, bk, dh), lambda i, kk, j, *_: (i, kk, 0)),
+                pl.BlockSpec((1, bk, dh), lambda i, kk, j, *_: (i, kk, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, dh), jnp.float32),
+                pltpu.VMEM((bk, dh), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, dh), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, dh), v.dtype),
+        ],
+        interpret=_INTERPRET,
+    )(seed_arr, *dkv_args)
+
+    dq = dq.reshape(b, h, tq, dh)
+    dk = dk.reshape(b, h, tk, dh)
+    dv = dv.reshape(b, h, tk, dh)
+    # Bias is mask plumbing (stop_gradient in every model); zeros keeps the
+    # vjp structure without materializing a t x t gradient.
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias, _seed_cotangent(seed)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

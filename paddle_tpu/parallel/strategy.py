@@ -30,22 +30,35 @@ class ShardingRule:
 
 
 class DistributedStrategy:
-    """mesh + data axis + parameter sharding rules."""
+    """mesh + data axis + parameter sharding rules.
+
+    ``strict=True`` makes an unmatched variable name an error instead of a
+    silent fall-through to replicated — a typo in a rule pattern otherwise
+    degrades tensor parallelism to replication with no signal.
+    """
 
     def __init__(
         self,
         mesh: Mesh,
         data_axis: Optional[str] = "data",
         rules: Sequence[ShardingRule] = (),
+        strict: bool = False,
     ):
         self.mesh = mesh
         self.data_axis = data_axis if data_axis in mesh.axis_names else None
         self.rules = list(rules)
+        self.strict = strict
 
     def spec_for(self, name: str) -> P:
         for r in self.rules:
             if r.matches(name):
                 return r.spec
+        if self.strict and self.rules:
+            raise ValueError(
+                f"strict sharding strategy: variable '{name}' matches no "
+                f"rule; add an explicit rule (use PartitionSpec() for "
+                f"replicated)"
+            )
         return P()  # replicated
 
     def sharding_for(self, name: str) -> NamedSharding:
@@ -71,24 +84,18 @@ def transformer_rules(model_axis: str = "model") -> List[ShardingRule]:
     """
     m = model_axis
     return [
-        ShardingRule(r"_colp\.w$", P(None, m)),
-        ShardingRule(r"_colp\.b$", P(m)),
-        ShardingRule(r"_rowp\.w$", P(m, None)),
-        ShardingRule(r"_rowp\.b$", P()),
-        ShardingRule(r"^(src|trg)_emb\.w$", P(None, None)),
-        ShardingRule(r"^proj_colp\.w$", P(None, m)),
-        # Optimizer accumulators (moment/velocity/...) inherit the
-        # parameter's sharding; beta-pow scalars fall through to replicated.
-        ShardingRule(
-            r"_colp\.w_(moment1|moment2|velocity|mean_square|mean_grad|squared|linear)",
-            P(None, m),
-        ),
-        ShardingRule(
-            r"_rowp\.w_(moment1|moment2|velocity|mean_square|mean_grad|squared|linear)",
-            P(m, None),
-        ),
-        ShardingRule(
-            r"_colp\.b_(moment1|moment2|velocity|mean_square|mean_grad|squared|linear)",
-            P(m),
-        ),
+        # Scalars, norms, and embeddings stay replicated. Listed first so the
+        # broader suffix rules below never claim a beta-pow scalar.
+        ShardingRule(r"_(beta1_pow|beta2_pow)_\d+$", P()),
+        ShardingRule(r"^learning_rate", P()),  # incl. scheduler step state
+        ShardingRule(r"_ln\.(scale|bias)(_|$)", P()),
+        ShardingRule(r"^(src|trg)_(emb|pos)\.w(_|$)", P()),
+        # Megatron TP: column-parallel shards the output dim, row-parallel
+        # the input dim (GSPMD inserts the all-reduce on the row-parallel
+        # matmul output). The (_|$) suffix makes optimizer accumulators
+        # (``{param}_moment1_0`` etc.) inherit the parameter's spec.
+        ShardingRule(r"_colp\.w(_|$)", P(None, m)),
+        ShardingRule(r"_colp\.b(_|$)", P(m)),
+        ShardingRule(r"_rowp\.w(_|$)", P(m, None)),
+        ShardingRule(r"_rowp\.b(_|$)", P()),
     ]
