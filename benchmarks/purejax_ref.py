@@ -1,0 +1,112 @@
+"""Hand-written JAX reference of the bench transformer (same shapes/dtypes)
+to isolate the achievable step time on this chip from the Program-IR
+lowering. Diagnostic tool only — not part of the framework."""
+
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+B, S, D, DI, H, L, V = 32, 256, 512, 2048, 8, 6, 10000
+DH = D // H
+
+
+def init_params(key):
+    ks = jax.random.split(key, 64)
+    p = {"emb": jax.random.normal(ks[0], (V, D)) * 0.02,
+         "proj": jax.random.normal(ks[1], (D, V)) * 0.02}
+    for i in range(L * 2):  # enc + dec-self (cross omitted: close enough)
+        k = jax.random.split(ks[2 + i], 8)
+        p[f"l{i}"] = {
+            "qkv": jax.random.normal(k[0], (D, 3 * D)) * 0.02,
+            "o": jax.random.normal(k[1], (D, D)) * 0.02,
+            "f1": jax.random.normal(k[2], (D, DI)) * 0.02,
+            "f2": jax.random.normal(k[3], (DI, D)) * 0.02,
+            "ln1": jnp.ones((D,)), "ln1b": jnp.zeros((D,)),
+            "ln2": jnp.ones((D,)), "ln2b": jnp.zeros((D,)),
+        }
+    return p
+
+
+def ln(x, s, b):
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, -1, keepdims=True)
+    v = jnp.var(xf, -1, keepdims=True)
+    return (((xf - m) * jax.lax.rsqrt(v + 1e-5)) * s + b).astype(x.dtype)
+
+
+def attn(x, p, key):
+    qkv = (x @ p["qkv"].astype(jnp.bfloat16)).reshape(B, S, 3, H, DH)
+    q, k, v = [qkv[:, :, i].transpose(0, 2, 1, 3) for i in range(3)]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(DH)
+    a = jax.nn.softmax(s, -1).astype(jnp.bfloat16)
+    keep = jax.random.bernoulli(key, 0.9, a.shape)
+    a = jnp.where(keep, a / 0.9, 0).astype(jnp.bfloat16)
+    o = jnp.einsum("bhqk,bhkd->bhqd", a, v)
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, D)
+    return o @ p["o"].astype(jnp.bfloat16)
+
+
+def layer(x, p, key):
+    k1, k2 = jax.random.split(key)
+    x = x + attn(ln(x, p["ln1"], p["ln1b"]), p, k1)
+    h = jax.nn.relu(ln(x, p["ln2"], p["ln2b"]) @ p["f1"].astype(jnp.bfloat16))
+    keep = jax.random.bernoulli(k2, 0.9, h.shape)
+    h = jnp.where(keep, h / 0.9, 0).astype(jnp.bfloat16)
+    return x + h @ p["f2"].astype(jnp.bfloat16)
+
+
+def loss_fn(p, ids, y, key):
+    x = p["emb"].astype(jnp.bfloat16)[ids]
+    for i in range(L * 2):
+        key, sub = jax.random.split(key)
+        x = layer(x, p[f"l{i}"], sub)
+    logits = (x @ p["proj"].astype(jnp.bfloat16)).astype(jnp.float32)
+    lp = jax.nn.log_softmax(logits, -1)
+    return -jnp.mean(jnp.take_along_axis(lp, y[..., None], -1))
+
+
+@jax.jit
+def step(p, m, v, t, ids, y, key):
+    loss, g = jax.value_and_grad(loss_fn)(p, ids, y, key)
+    b1, b2, lr, eps = 0.9, 0.999, 1e-4, 1e-8
+    t = t + 1
+    m = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), m, g)
+    v = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v, g)
+    def upd(p, m, v):
+        mh = m / (1 - b1 ** t)
+        vh = v / (1 - b2 ** t)
+        return p - lr * mh / (jnp.sqrt(vh) + eps)
+    p = jax.tree.map(upd, p, m, v)
+    return p, m, v, t, loss
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    p = init_params(key)
+    m = jax.tree.map(jnp.zeros_like, p)
+    v = jax.tree.map(jnp.zeros_like, p)
+    t = jnp.zeros((), jnp.int32)
+    ids = jnp.asarray(np.random.randint(0, V, (B, S)))
+    y = jnp.asarray(np.random.randint(0, V, (B, S)))
+    t0 = time.time()
+    p, m, v, t, loss = step(p, m, v, t, ids, y, key)
+    jax.block_until_ready(loss)
+    print(f"compile+1st: {time.time()-t0:.1f}s")
+    for _ in range(3):
+        p, m, v, t, loss = step(p, m, v, t, ids, y, key)
+    jax.block_until_ready(loss)
+    n = 20
+    t0 = time.time()
+    for _ in range(n):
+        p, m, v, t, loss = step(p, m, v, t, ids, y, key)
+    jax.block_until_ready(loss)
+    dt = (time.time() - t0) / n
+    flops = 3 * (2 * B * S * (L * 2) * (4 * D * D + 2 * D * DI) + 2 * B * S * D * V
+                 + (L * 2) * 2 * 2 * B * S * S * D)
+    print(f"step: {dt*1000:.1f}ms  ~MFU={flops/dt/197e12:.3f}")
+
+
+if __name__ == "__main__":
+    main()

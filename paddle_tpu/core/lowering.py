@@ -31,10 +31,14 @@ from paddle_tpu.framework import Block, Program
 # Ops handled by the lowering itself rather than a registered kernel.
 _STRUCTURAL_OPS = ("feed", "fetch")
 
-# MXU-heavy ops that run in bfloat16 under AMP (f32 master weights stay in
-# the state; casts fuse into the matmul). The analog of the reference's AMP
-# cast insertion (reference: contrib/mixed_precision/fp16_utils.py:67), but
-# bf16 needs no loss scaling (SURVEY.md section 7 phase 4).
+# MXU-heavy ops that run in bfloat16 under AMP: every f32 input (master
+# weights included) is cast to bf16 and the output STAYS bf16, so the whole
+# activation stream between matmuls lives in bf16 — halving HBM traffic,
+# which profiling showed was the step-time bound (casting back to f32 after
+# each matmul made every matmul bandwidth-limited). The analog of the
+# reference's AMP cast insertion (reference:
+# contrib/mixed_precision/fp16_utils.py:67), but bf16 needs no loss scaling
+# (SURVEY.md section 7 phase 4).
 AMP_OP_TYPES = {
     "mul",
     "matmul",
@@ -44,33 +48,60 @@ AMP_OP_TYPES = {
     "scaled_dot_product_attention",
 }
 
+# Precision-following ops: when any input is already bf16, their remaining
+# f32 float inputs (params like layer-norm scale, residual branches) are
+# cast down so the op does not silently promote the stream back to f32.
+# Numerically sensitive reductions inside these kernels (layer-norm
+# mean/var) compute in f32 internally regardless of input dtype.
+AMP_FLOW_OP_TYPES = {
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "scale",
+    "dropout",
+    "relu",
+    "gelu",
+    "tanh",
+    "sigmoid",
+    "softmax",
+    "concat",
+    "stack",
+}
+# (layer_norm is absent: its kernel handles mixed dtypes itself — f32
+# internal math, x-dtype output — so no input casting is wanted.)
+
+
+def _is_f32(v):
+    return v is not None and hasattr(v, "dtype") and v.dtype == jnp.float32
+
+
+def _is_bf16(v):
+    return v is not None and hasattr(v, "dtype") and v.dtype == jnp.bfloat16
+
+
+# Slots that must stay f32 under AMP (saved numerical stats, not streams).
+AMP_KEEP_F32_SLOTS = frozenset({"Lse", "GRAD::Lse"})
+
 
 def _amp_cast_ins(ins):
-    import jax.numpy as _jnp
-
     out = {}
     for slot, vals in ins.items():
+        if slot in AMP_KEEP_F32_SLOTS:
+            out[slot] = list(vals)
+            continue
         out[slot] = [
-            v.astype(_jnp.bfloat16)
-            if v is not None and hasattr(v, "dtype") and v.dtype == _jnp.float32
-            else v
-            for v in vals
+            v.astype(jnp.bfloat16) if _is_f32(v) else v for v in vals
         ]
     return out
 
 
-def _amp_cast_outs(outs):
-    import jax.numpy as _jnp
-
-    res = {}
-    for slot, vals in outs.items():
-        res[slot] = [
-            v.astype(_jnp.float32)
-            if v is not None and hasattr(v, "dtype") and v.dtype == _jnp.bfloat16
-            else v
-            for v in vals
-        ]
-    return res
+def _amp_flow_cast_ins(ins):
+    """Cast f32 inputs to bf16 only when the op already consumes bf16."""
+    has_bf16 = any(_is_bf16(v) for vals in ins.values() for v in vals)
+    if not has_bf16:
+        return ins
+    return _amp_cast_ins(ins)
 
 
 def resolve_op_def(op_type: str) -> OpDef:
@@ -187,9 +218,9 @@ def lower_block(
             )
             if amp and base_type in AMP_OP_TYPES:
                 ins = _amp_cast_ins(ins)
-                outs = _amp_cast_outs(opdef.compute(ins, dict(op.attrs), **kwargs))
-            else:
-                outs = opdef.compute(ins, dict(op.attrs), **kwargs)
+            elif amp and base_type in AMP_FLOW_OP_TYPES:
+                ins = _amp_flow_cast_ins(ins)
+            outs = opdef.compute(ins, dict(op.attrs), **kwargs)
             for slot, names in op.outputs.items():
                 vals = outs.get(slot, [])
                 for i, n in enumerate(names):

@@ -92,26 +92,35 @@ def _positional_encoding(max_len: int, d_model: int) -> np.ndarray:
 def _multi_head_attention(q_in, kv_in, bias, cfg: TransformerConfig, prefix: str,
                           is_test: bool):
     h, dh, d = cfg.n_head, cfg.d_head, cfg.d_model
-    q = _fc(q_in, d, f"{prefix}_q", "colp")
-    k = _fc(kv_in, d, f"{prefix}_k", "colp")
-    v = _fc(kv_in, d, f"{prefix}_v", "colp")
 
     def split_heads(x):
         x = layers.reshape(x, [0, 0, h, dh])
         return layers.transpose(x, [0, 2, 1, 3])  # [b, h, t, dh]
 
+    if q_in is kv_in:
+        # self-attention: one fused [d, 3d] projection (one MXU pass
+        # instead of three; the reference emits separate q/k/v fcs)
+        qkv = _fc(q_in, 3 * d, f"{prefix}_qkv", "colp")
+        q, k, v = layers.split(qkv, 3, dim=-1)
+    else:
+        q = _fc(q_in, d, f"{prefix}_q", "colp")
+        k = _fc(kv_in, d, f"{prefix}_k", "colp")
+        v = _fc(kv_in, d, f"{prefix}_v", "colp")
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     from paddle_tpu.layer_helper import LayerHelper
 
     helper = LayerHelper(f"{prefix}_sdpa")
     ctx = helper.create_variable_for_type_inference(dtype=cfg.dtype)
+    # logsumexp rows, consumed by the paired grad op (DCE'd at inference)
+    lse = helper.create_variable_for_type_inference(dtype="float32")
+    lse.stop_gradient = True
     inputs = {"Q": q, "K": k, "V": v}
     if bias is not None:
         inputs["Bias"] = bias
     helper.append_op(
         "scaled_dot_product_attention",
         inputs=inputs,
-        outputs={"Out": ctx},
+        outputs={"Out": ctx, "Lse": lse},
         attrs={
             "scale": 1.0 / math.sqrt(dh),
             "dropout_prob": float(cfg.dropout),

@@ -50,6 +50,31 @@ def _attn_bias(ins, attrs):
     return {"Out": [out]}
 
 
+def _sdpa_config(ins, attrs, rng):
+    """Shared fwd/grad config: (scale, p_drop, seed, use_pallas).
+
+    The grad op's rng is folded with the SAME forward_op_idx as the
+    forward's (core/lowering.py), so the derived dropout seed — and hence
+    the in-kernel mask — is identical in both directions.
+    """
+    q = _x(ins, "Q")
+    scale = attrs.get("scale", None)
+    if scale is None:
+        scale = 1.0 / math.sqrt(jnp.shape(q)[-1])
+    p_drop = attrs.get("dropout_prob", 0.0)
+    training_dropout = p_drop > 0.0 and not attrs.get("is_test", False)
+    seed = None
+    drop = 0.0
+    if training_dropout:
+        drop = float(p_drop)
+        seed = jax.random.randint(rng, (), 0, 2**31 - 1, dtype=jnp.int32)
+    use_pallas = (
+        jax.default_backend() == "tpu"
+        and attrs.get("use_pallas", True)
+    )
+    return scale, drop, seed, use_pallas
+
+
 @register_op("scaled_dot_product_attention", diff_inputs=("Q", "K", "V"),
              needs_rng=True)
 def _sdpa(ins, attrs, rng=None):
@@ -59,43 +84,52 @@ def _sdpa(ins, attrs, rng=None):
     (paddle_tpu/parallel/flash_attention.py), including training-time
     attention dropout, which runs inside the kernel from a per-step seed.
     Off-TPU (or in the numeric-grad harness) it uses the jnp composition,
-    which XLA fuses.
+    which XLA fuses. Also emits the logsumexp rows (Lse) so the paired
+    grad op below can run the blocked backward kernels WITHOUT re-running
+    the forward (XLA cannot CSE custom calls; DCE'd when unused).
     """
     q, k, v = _x(ins, "Q"), _x(ins, "K"), _x(ins, "V")
     bias = _x(ins, "Bias")
-    scale = attrs.get("scale", None)
-    if scale is None:
-        scale = 1.0 / math.sqrt(jnp.shape(q)[-1])
-    p_drop = attrs.get("dropout_prob", 0.0)
-    training_dropout = p_drop > 0.0 and not attrs.get("is_test", False)
-    use_pallas = (
-        jax.default_backend() == "tpu"
-        and attrs.get("use_pallas", True)
-    )
-    if use_pallas:
-        from paddle_tpu.parallel.flash_attention import flash_attention
+    scale, drop, seed, use_pallas = _sdpa_config(ins, attrs, rng)
+    from paddle_tpu.parallel import flash_attention as fa
 
-        seed = None
-        drop = 0.0
-        if training_dropout:
-            # Attention dropout runs inside the kernel (regenerated from
-            # this seed in the backward) — the dense fallback round 1 took
-            # here materialized the t x t score matrix in HBM.
-            drop = float(p_drop)
-            seed = jax.random.randint(rng, (), 0, 2**31 - 1, dtype=jnp.int32)
-        out = flash_attention(q, k, v, bias=bias, seed=seed, scale=scale,
-                              p_drop=drop)
+    if use_pallas:
+        out, lse = fa.flash_attention_fwd(q, k, v, bias=bias, seed=seed,
+                                          scale=scale, p_drop=drop)
     else:
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                            preferred_element_type=jnp.float32) * scale
-        if bias is not None:
-            scores = scores + bias.astype(scores.dtype)
-        # softmax reduction in f32, then drop to the value dtype so the
-        # materialized attention matrix (the big HBM buffer) is bf16 under
-        # AMP and the dropout where() streams half the bytes
-        attn = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-        if training_dropout:
-            keep = jax.random.bernoulli(rng, 1.0 - p_drop, jnp.shape(attn))
-            attn = jnp.where(keep, attn / (1.0 - p_drop), 0.0).astype(v.dtype)
-        out = jnp.einsum("bhqk,bhkd->bhqd", attn, v)
-    return {"Out": [out.astype(q.dtype)]}
+        out = fa._reference_attention(q, k, v, bias, scale, drop,
+                                      seed if drop > 0.0 else None)
+        lse = jnp.zeros(jnp.shape(q)[:3] + (1,), jnp.float32)
+    return {"Out": [out.astype(q.dtype)], "Lse": [lse]}
+
+
+@register_op("scaled_dot_product_attention_grad", no_grad=True,
+             needs_rng=True)
+def _sdpa_grad(ins, attrs, rng=None):
+    """Blocked flash-attention backward consuming the forward's saved
+    (Out, Lse) — no forward re-execution (cf. the auto vjp path, which
+    would re-run the kernel because custom calls are opaque to CSE)."""
+    q, k, v = _x(ins, "Q"), _x(ins, "K"), _x(ins, "V")
+    bias = _x(ins, "Bias")
+    out, lse = _x(ins, "Out"), _x(ins, "Lse")
+    g = _x(ins, "GRAD::Out")
+    scale, drop, seed, use_pallas = _sdpa_config(ins, attrs, rng)
+    from paddle_tpu.parallel import flash_attention as fa
+
+    if use_pallas:
+        # gates internally between the blocked Pallas kernels and a vjp of
+        # the same dense composition the forward used — one source of truth
+        # for masks and fallback conditions
+        dq, dk, dv = fa.flash_attention_bwd(
+            q, k, v, bias, seed, out, lse, g.astype(q.dtype),
+            scale=scale, p_drop=drop)
+    else:
+        sd = seed if drop > 0.0 else None
+
+        def f(q, k, v):
+            return fa._reference_attention(q, k, v, bias, scale, drop,
+                                           sd).astype(q.dtype)
+
+        _, vjp = jax.vjp(f, q, k, v)
+        dq, dk, dv = vjp(g.astype(q.dtype))
+    return {"GRAD::Q": [dq], "GRAD::K": [dk], "GRAD::V": [dv]}

@@ -184,15 +184,23 @@ def _layer_norm(ins, attrs):
     eps = attrs.get("epsilon", 1e-5)
     begin = attrs.get("begin_norm_axis", 1)
     axes = tuple(range(begin, jnp.ndim(x)))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
+    # All internal math in f32 regardless of the activation dtype (bf16
+    # under AMP): stats are precision-sensitive, and doing the affine in
+    # f32 keeps the scale/bias gradient reductions in f32 through the vjp.
+    # Only the final result returns to x's dtype, so the HBM stream stays
+    # bf16 and the f32 intermediates live inside the XLA fusion.
+    stat_dtype = jnp.promote_types(x.dtype, jnp.float32)  # f32 unless f64
+    xf = x.astype(stat_dtype)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
     inv = jax.lax.rsqrt(var + eps)
-    y = (x - mean) * inv
+    y = (xf - mean) * inv
     feat_shape = jnp.shape(x)[begin:]
     if scale is not None:
-        y = y * jnp.reshape(scale, (1,) * begin + feat_shape)
+        y = y * jnp.reshape(scale, (1,) * begin + feat_shape).astype(stat_dtype)
     if bias is not None:
-        y = y + jnp.reshape(bias, (1,) * begin + feat_shape)
+        y = y + jnp.reshape(bias, (1,) * begin + feat_shape).astype(stat_dtype)
+    y = y.astype(x.dtype)
     return {
         "Y": [y],
         "Mean": [jax.lax.stop_gradient(jnp.reshape(mean, (-1,)))],
@@ -256,7 +264,10 @@ def _softmax_with_cross_entropy(ins, attrs):
     logits, label = _x(ins, "Logits"), _x(ins, "Label")
     soft_label = attrs.get("soft_label", False)
     ignore_index = attrs.get("ignore_index", -100)
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    # logsumexp over the vocab in >=f32 even when the logits stream is bf16
+    # (AMP): the reduction is precision-sensitive, the cast fuses.
+    logp = jax.nn.log_softmax(
+        logits.astype(jnp.promote_types(logits.dtype, jnp.float32)), axis=-1)
     if soft_label:
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:

@@ -1,23 +1,34 @@
-"""Pallas TPU flash attention (FlashAttention-2 style).
+"""Pallas TPU flash attention (FlashAttention-2 style, head-batched).
 
 The hot op of the transformer family (SURVEY.md section 7: "pallas kernels
 for the hot ops"). Both directions are K-blocked with online softmax: the
 score matrix never exists at full [tq, tk] size in any memory space, so
-VMEM use is O(block^2) and HBM traffic is O(t) regardless of context
+VMEM use is O(h * block^2) and HBM traffic is O(t) regardless of context
 length — the property the long-context/ring-attention path builds on.
 
-- Forward: grid (b*h, tq/bq, tk/bk); per q-block running (m, l, acc)
-  carried in VMEM scratch across the k-block loop; emits the output and
-  the logsumexp rows needed by the backward.
-- Backward: recompute p = exp(s - lse) per block (no stored attention
-  matrix). dq in one kernel (k-blocks inner), dk/dv in a second kernel
-  (q-blocks inner), using the standard delta = rowsum(do * o) reduction.
-- Attention dropout runs inside the kernels via the TPU PRNG: the mask
-  for score block (bh, jq, jk) is regenerated from (seed, bh, jq, jk) in
-  every kernel, so forward and backward see identical masks and nothing
+Blocks batch ALL heads of one batch element per grid step ((1, h, bq, dh)
+blocks over the native [b, h, t, dh] layout). At short sequence lengths a
+per-(b*h) grid is dominated by per-step DMA/setup overhead (measured 331us
+per 44us-ideal forward at t=256); head-batching amortizes it 8x.
+
+- Forward: grid (b, tq/bq, tk/bk); running (m, l, acc) in VMEM scratch
+  across the k-block loop; emits the output AND the logsumexp rows.
+- Backward: recompute p = exp(s - lse) per block (no stored attention).
+  dq in one kernel (k-blocks inner), dk/dv in a second (q-blocks inner),
+  using the standard delta = rowsum(do * o) reduction. Exposed as
+  ``flash_attention_bwd`` so the framework's sdpa_grad op can consume the
+  forward's saved (out, lse) instead of re-running the forward kernel
+  (XLA cannot CSE custom calls, so a vjp-style recompute would execute).
+- Attention dropout runs inside the kernels via the TPU PRNG: the mask for
+  score block (b, jq, jk) is regenerated from a hash of (seed, b, jq, jk)
+  in every kernel, so forward and backward see identical masks and nothing
   is stored.
 
-Layout: q, k, v are [b, h, t, dh]; bias is additive [b, 1|h, 1|tq, tk].
+``bias`` is additive [b, 1|h, 1|tq, tk] mask plumbing, NOT a trainable
+input: its cotangent is zeros on the Pallas path (computing it would
+materialize a t x t gradient). Use the dense composition for a learnable
+additive bias.
+
 Falls back to the dense jnp composition off-TPU or when the sequence
 lengths don't divide the block sizes.
 """
@@ -37,6 +48,10 @@ DEFAULT_Q_BLOCK = 256
 DEFAULT_K_BLOCK = 256
 _NEG_INF = -1e30
 
+# Soft cap on the f32 score block (h * bq * bk * 4B); bq halves until the
+# block fits alongside q/k/v/acc in ~16MB VMEM.
+_SCORE_VMEM_BYTES = 8 * 2**20
+
 # Test hook: run the Pallas kernels in interpreter mode on CPU so the
 # blocked online-softmax path itself is exercised by the pytest suite
 # (the reference-composition fallback would otherwise shadow it off-TPU).
@@ -44,9 +59,9 @@ _INTERPRET = False
 
 
 def _block_seed(seed, i, j, kk):
-    """Mix (seed, batch-head, q-block, k-block) into one scalar for the
-    per-core PRNG (the multi-operand prng_seed form doesn't lower on all
-    backends). int32 wraparound is the hash."""
+    """Mix (seed, batch, q-block, k-block) into one scalar for the per-core
+    PRNG (the multi-operand prng_seed form doesn't lower on all backends).
+    int32 wraparound is the hash."""
     s = seed
     for x in (i, j, kk):
         s = (s * jnp.int32(1000003)) ^ jnp.int32(x)
@@ -59,6 +74,29 @@ def _dropout_mask(p_keep: float, shape):
     bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
     thresh = jnp.uint32(int(p_keep * float(2**32 - 1)))
     return (bits < thresh).astype(jnp.float32) * (1.0 / p_keep)
+
+
+def _pick_blocks(h, tq, tk, q_block, k_block):
+    bq = min(q_block, tq)
+    bk = min(k_block, tk)
+    while h * bq * bk * 4 > _SCORE_VMEM_BYTES and bq > 128:
+        bq //= 2
+    return bq, bk
+
+
+def _use_pallas(tq, tk, bq, bk):
+    return (
+        (jax.default_backend() == "tpu" or _INTERPRET)
+        and tq % bq == 0
+        and tk % bk == 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# kernels — refs are blocks of the native [b, h, t, dh] layout; index 0
+# drops the leading size-1 batch-block dim, so shapes below are
+# q (h, bq, dh) / k, v (h, bk, dh) / bias (hb, 1|bq, bk) / lse (h, bq, 1).
+# ---------------------------------------------------------------------------
 
 
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
@@ -75,14 +113,14 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     k = k_ref[0]
     v = v_ref[0]
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
+        q, k, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     ) * scale
     if bias_ref is not None:
         s = s + bias_ref[0].astype(jnp.float32)
 
-    m_prev = m_scr[:, :1]
-    l_prev = l_scr[:, :1]
+    m_prev = m_scr[:, :, :1]
+    l_prev = l_scr[:, :, :1]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
     corr = jnp.exp(m_prev - m_new)
@@ -94,7 +132,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         p = p * _dropout_mask(1.0 - p_drop, p.shape)
 
     acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )
     m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
@@ -102,9 +140,9 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
 
     @pl.when(kk == nk - 1)
     def _finish():
-        l = l_scr[:, :1]
+        l = l_scr[:, :, :1]
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:, :1] + jnp.log(l_scr[:, :1])
+        lse_ref[0] = m_scr[:, :, :1] + jnp.log(l)
 
 
 def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
@@ -119,11 +157,11 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    lse = lse_ref[0]        # [bq, 1] f32
-    delta = delta_ref[0]    # [bq, 1] f32
+    lse = lse_ref[0]        # (h, bq, 1) f32
+    delta = delta_ref[0]    # (h, bq, 1) f32
 
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
+        q, k, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     ) * scale
     if bias_ref is not None:
@@ -131,7 +169,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     p = jnp.exp(s - lse)  # post-softmax probabilities, recomputed
 
     dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
+        do, v, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )
     if p_drop > 0.0:
@@ -140,7 +178,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         dp = dp * _dropout_mask(1.0 - p_drop, dp.shape)
     ds = p * (dp - delta) * scale
     dq_scr[:] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        ds.astype(k.dtype), k, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )
 
@@ -163,43 +201,45 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
     k = k_ref[0]
     v = v_ref[0]
     do = do_ref[0]
-    lse = lse_ref[0]      # [bq, 1]
-    delta = delta_ref[0]  # [bq, 1]
+    lse_t = jnp.transpose(lse_ref[0], (0, 2, 1))      # (h, 1, bq)
+    delta_t = jnp.transpose(delta_ref[0], (0, 2, 1))  # (h, 1, bq)
 
-    # Work in the transposed orientation: s_t[kk, qq]
+    # Work in the transposed orientation: s_t (h, bk, bq)
     s_t = jax.lax.dot_general(
-        k, q, (((1,), (1,)), ((), ())),
+        k, q, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     ) * scale
     if bias_ref is not None:
-        s_t = s_t + jnp.transpose(bias_ref[0].astype(jnp.float32))
-    p_t = jnp.exp(s_t - jnp.transpose(lse))  # [bk, bq]
+        s_t = s_t + jnp.transpose(bias_ref[0].astype(jnp.float32), (0, 2, 1))
+    p_t = jnp.exp(s_t - lse_t)
 
     if p_drop > 0.0:
-        # Same (bh, q-block, k-block) stream as the forward, generated in
-        # the forward's (bq, bk) orientation then transposed.
+        # Same (b, q-block, k-block) stream as the forward, generated in the
+        # forward's (h, bq, bk) orientation then transposed.
         pltpu.prng_seed(
             _block_seed(seed_ref[0], pl.program_id(0), jq, pl.program_id(1)))
         drop_t = jnp.transpose(
-            _dropout_mask(1.0 - p_drop, (p_t.shape[1], p_t.shape[0]))
+            _dropout_mask(
+                1.0 - p_drop, (p_t.shape[0], p_t.shape[2], p_t.shape[1])),
+            (0, 2, 1),
         )
         pd_t = p_t * drop_t
     else:
         pd_t = p_t
 
     dv_scr[:] += jax.lax.dot_general(
-        pd_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+        pd_t.astype(do.dtype), do, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )
     dp_t = jax.lax.dot_general(
-        v, do, (((1,), (1,)), ((), ())),
+        v, do, (((2,), (2,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )
     if p_drop > 0.0:
         dp_t = dp_t * drop_t
-    ds_t = p_t * (dp_t - jnp.transpose(delta)) * scale
+    ds_t = p_t * (dp_t - delta_t) * scale
     dk_scr[:] += jax.lax.dot_general(
-        ds_t.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+        ds_t.astype(q.dtype), q, (((2,), (1,)), ((0,), (0,))),
         preferred_element_type=jnp.float32,
     )
 
@@ -209,30 +249,23 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bias_spec(bias, b, h, bq, bk, *, transposed=False):
-    """BlockSpec for the stored-rank bias [b, 1|h, 1|tq, tk], reshaped to
-    (b or b*h, 1|tq, tk). Index maps take grid (i=bh, j=qblk, kk=kblk);
-    when ``transposed`` the grid is (i, kk, j)."""
+def _bias_spec(bias, bq, bk, *, transposed=False):
+    """BlockSpec for the stored-rank bias [b, 1|h, 1|tq, tk]. Index maps
+    take grid (i=batch, j=qblk, kk=kblk); ``transposed`` grids are
+    (i, kk, j)."""
     hb, tq_b = bias.shape[1], bias.shape[2]
-    tk = bias.shape[3]
-    if hb == 1:
-        arr = bias.reshape(bias.shape[0], tq_b, tk)
-        bsel = lambda i: i // h
-    else:
-        arr = bias.reshape(bias.shape[0] * hb, tq_b, tk)
-        bsel = lambda i: i
     qdim = 1 if tq_b == 1 else bq
     if transposed:
         if tq_b == 1:
-            idx = lambda i, kk, j, *_: (bsel(i), 0, kk)
+            idx = lambda i, kk, j, *_: (i, 0, 0, kk)
         else:
-            idx = lambda i, kk, j, *_: (bsel(i), j, kk)
+            idx = lambda i, kk, j, *_: (i, 0, j, kk)
     else:
         if tq_b == 1:
-            idx = lambda i, j, kk, *_: (bsel(i), 0, kk)
+            idx = lambda i, j, kk, *_: (i, 0, 0, kk)
         else:
-            idx = lambda i, j, kk, *_: (bsel(i), j, kk)
-    return arr, pl.BlockSpec((1, qdim, bk), idx)
+            idx = lambda i, j, kk, *_: (i, 0, j, kk)
+    return pl.BlockSpec((1, hb, qdim, bk), idx)
 
 
 def _reference_attention(q, k, v, bias, scale, p_drop=0.0, seed=None):
@@ -248,6 +281,12 @@ def _reference_attention(q, k, v, bias, scale, p_drop=0.0, seed=None):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
+def _seed_arr(seed):
+    if seed is None:
+        return jnp.zeros((1,), jnp.int32)
+    return jnp.asarray(seed, jnp.int32).reshape((1,))
+
+
 def _seed_cotangent(seed):
     """Symbolic-zero cotangent for the integer seed operand."""
     if seed is None:
@@ -257,61 +296,43 @@ def _seed_cotangent(seed):
     return _np.zeros(_np.shape(seed), jax.dtypes.float0)
 
 
-def _use_pallas(tq, tk, bq, bk):
-    return (
-        (jax.default_backend() == "tpu" or _INTERPRET)
-        and tq % bq == 0
-        and tk % bk == 0
-    )
+# ---------------------------------------------------------------------------
+# functional entry points (used directly by the sdpa op pair)
+# ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def flash_attention(q, k, v, bias=None, seed=None,
-                    scale: Optional[float] = None, p_drop: float = 0.0,
-                    q_block: int = DEFAULT_Q_BLOCK,
-                    k_block: int = DEFAULT_K_BLOCK):
-    """o = dropout(softmax(q k^T * scale + bias)) v.
-
-    ``seed``: int32 scalar array driving attention dropout (ignored when
-    p_drop == 0).
-
-    ``bias`` is treated as mask plumbing, NOT a trainable input: on the
-    Pallas path its cotangent is zeros (computing it would materialize a
-    t x t gradient, defeating the kernel). Use the dense composition if a
-    learnable additive bias must receive gradients.
-    """
-    out, _ = _flash_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block)
-    return out
-
-
-def _flash_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block):
+def flash_attention_fwd(q, k, v, bias=None, seed=None, scale=None,
+                        p_drop: float = 0.0,
+                        q_block: int = DEFAULT_Q_BLOCK,
+                        k_block: int = DEFAULT_K_BLOCK):
+    """-> (out, lse) with lse [b, h, tq, 1] f32 (zeros on the dense path,
+    which needs no saved stats: its backward recomputes via vjp)."""
+    if p_drop > 0.0 and seed is None:
+        raise ValueError(
+            "flash_attention: p_drop > 0 requires a per-step `seed`; "
+            "without one the SAME mask would be applied every step, which "
+            "is not dropout"
+        )
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     b, h, tq, dh = q.shape
     tk = k.shape[2]
-    bq = min(q_block, tq)
-    bk = min(k_block, tk)
+    bq, bk = _pick_blocks(h, tq, tk, q_block, k_block)
     if not _use_pallas(tq, tk, bq, bk):
         out = _reference_attention(q, k, v, bias, scale, p_drop,
                                    seed if p_drop > 0.0 else None)
-        return out, (q, k, v, bias, seed, None, None)
+        return out, jnp.zeros((b, h, tq, 1), jnp.float32)
 
-    bh = b * h
     nq, nk = tq // bq, tk // bk
-    q_r = q.reshape(bh, tq, dh)
-    k_r = k.reshape(bh, tk, dh)
-    v_r = v.reshape(bh, tk, dh)
-
     in_specs = [
-        pl.BlockSpec((1, bq, dh), lambda i, j, kk, *_: (i, j, 0)),
-        pl.BlockSpec((1, bk, dh), lambda i, j, kk, *_: (i, kk, 0)),
-        pl.BlockSpec((1, bk, dh), lambda i, j, kk, *_: (i, kk, 0)),
+        pl.BlockSpec((1, h, bq, dh), lambda i, j, kk, *_: (i, 0, j, 0)),
+        pl.BlockSpec((1, h, bk, dh), lambda i, j, kk, *_: (i, 0, kk, 0)),
+        pl.BlockSpec((1, h, bk, dh), lambda i, j, kk, *_: (i, 0, kk, 0)),
     ]
-    args = [q_r, k_r, v_r]
+    args = [q, k, v]
     if bias is not None:
-        bias_arr, spec = _bias_spec(bias, b, h, bq, bk)
-        in_specs.append(spec)
-        args.append(bias_arr)
+        in_specs.append(_bias_spec(bias, bq, bk))
+        args.append(bias)
         kernel = functools.partial(_fwd_kernel, scale=scale, nk=nk,
                                    p_drop=p_drop)
     else:
@@ -321,82 +342,64 @@ def _flash_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block):
             scale=scale, nk=nk, p_drop=p_drop,
         )
 
-    seed_arr = jnp.zeros((1,), jnp.int32) if seed is None else (
-        jnp.asarray(seed, jnp.int32).reshape((1,))
-    )
-
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(bh, nq, nk),
+            grid=(b, nq, nk),
             in_specs=in_specs,
             out_specs=[
-                pl.BlockSpec((1, bq, dh), lambda i, j, kk, *_: (i, j, 0)),
-                pl.BlockSpec((1, bq, 1), lambda i, j, kk, *_: (i, j, 0)),
+                pl.BlockSpec((1, h, bq, dh), lambda i, j, kk, *_: (i, 0, j, 0)),
+                pl.BlockSpec((1, h, bq, 1), lambda i, j, kk, *_: (i, 0, j, 0)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((bq, 128), jnp.float32),
-                pltpu.VMEM((bq, 128), jnp.float32),
-                pltpu.VMEM((bq, dh), jnp.float32),
+                pltpu.VMEM((h, bq, 128), jnp.float32),
+                pltpu.VMEM((h, bq, 128), jnp.float32),
+                pltpu.VMEM((h, bq, dh), jnp.float32),
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq, dh), q.dtype),
-            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, tq, dh), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
         ],
         interpret=_INTERPRET,
-    )(seed_arr, *args)
-    return out.reshape(b, h, tq, dh), (q, k, v, bias, seed, out, lse)
+    )(_seed_arr(seed), *args)
+    return out, lse
 
 
-def _flash_bwd(scale, p_drop, q_block, k_block, res, g):
-    q, k, v, bias, seed, out, lse = res
+def flash_attention_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
+                        p_drop: float = 0.0,
+                        q_block: int = DEFAULT_Q_BLOCK,
+                        k_block: int = DEFAULT_K_BLOCK):
+    """-> (dq, dk, dv), consuming the forward's saved (out, lse)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     b, h, tq, dh = q.shape
     tk = k.shape[2]
-    bq = min(q_block, tq)
-    bk = min(k_block, tk)
-
-    if out is None:  # forward took the dense path; mirror it
-        def f(q, k, v, bias):
+    bq, bk = _pick_blocks(h, tq, tk, q_block, k_block)
+    if not _use_pallas(tq, tk, bq, bk):
+        def f(q, k, v):
             return _reference_attention(q, k, v, bias, scale, p_drop,
                                         seed if p_drop > 0.0 else None)
 
-        if bias is None:
-            _, vjp = jax.vjp(lambda a, bb, c: f(a, bb, c, None), q, k, v)
-            dq, dk, dv = vjp(g)
-            return dq, dk, dv, None, _seed_cotangent(seed)
-        _, vjp = jax.vjp(f, q, k, v, bias)
-        dq, dk, dv, dbias = vjp(g)
-        return dq, dk, dv, dbias, _seed_cotangent(seed)
+        _, vjp = jax.vjp(f, q, k, v)
+        return vjp(g)
 
-    bh = b * h
     nq, nk = tq // bq, tk // bk
-    q_r = q.reshape(bh, tq, dh)
-    k_r = k.reshape(bh, tk, dh)
-    v_r = v.reshape(bh, tk, dh)
-    do_r = g.reshape(bh, tq, dh)
-    out_r = out  # already [bh, tq, dh]
-    delta = jnp.sum(do_r.astype(jnp.float32) * out_r.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # [bh, tq, 1]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [b, h, tq, 1]
+    seed_arr = _seed_arr(seed)
 
-    seed_arr = jnp.zeros((1,), jnp.int32) if seed is None else (
-        jnp.asarray(seed, jnp.int32).reshape((1,))
-    )
-
-    # --- dq: grid (bh, nq, nk), k-blocks inner ---
+    # --- dq: grid (b, nq, nk), k-blocks inner ---
     dq_specs = [
-        pl.BlockSpec((1, bq, dh), lambda i, j, kk, *_: (i, j, 0)),   # q
-        pl.BlockSpec((1, bk, dh), lambda i, j, kk, *_: (i, kk, 0)),  # k
-        pl.BlockSpec((1, bk, dh), lambda i, j, kk, *_: (i, kk, 0)),  # v
+        pl.BlockSpec((1, h, bq, dh), lambda i, j, kk, *_: (i, 0, j, 0)),   # q
+        pl.BlockSpec((1, h, bk, dh), lambda i, j, kk, *_: (i, 0, kk, 0)),  # k
+        pl.BlockSpec((1, h, bk, dh), lambda i, j, kk, *_: (i, 0, kk, 0)),  # v
     ]
-    dq_args = [q_r, k_r, v_r]
+    dq_args = [q, k, v]
     if bias is not None:
-        bias_arr, spec = _bias_spec(bias, b, h, bq, bk)
-        dq_specs.append(spec)
-        dq_args.append(bias_arr)
+        dq_specs.append(_bias_spec(bias, bq, bk))
+        dq_args.append(bias)
         dq_kernel = functools.partial(_dq_kernel, scale=scale, nk=nk,
                                       p_drop=p_drop)
     else:
@@ -406,36 +409,36 @@ def _flash_bwd(scale, p_drop, q_block, k_block, res, g):
             scale=scale, nk=nk, p_drop=p_drop,
         )
     dq_specs += [
-        pl.BlockSpec((1, bq, dh), lambda i, j, kk, *_: (i, j, 0)),  # do
-        pl.BlockSpec((1, bq, 1), lambda i, j, kk, *_: (i, j, 0)),   # lse
-        pl.BlockSpec((1, bq, 1), lambda i, j, kk, *_: (i, j, 0)),   # delta
+        pl.BlockSpec((1, h, bq, dh), lambda i, j, kk, *_: (i, 0, j, 0)),  # do
+        pl.BlockSpec((1, h, bq, 1), lambda i, j, kk, *_: (i, 0, j, 0)),   # lse
+        pl.BlockSpec((1, h, bq, 1), lambda i, j, kk, *_: (i, 0, j, 0)),   # delta
     ]
-    dq_args += [do_r, lse, delta]
+    dq_args += [g, lse, delta]
 
     dq = pl.pallas_call(
         dq_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(bh, nq, nk),
+            grid=(b, nq, nk),
             in_specs=dq_specs,
-            out_specs=pl.BlockSpec((1, bq, dh), lambda i, j, kk, *_: (i, j, 0)),
-            scratch_shapes=[pltpu.VMEM((bq, dh), jnp.float32)],
+            out_specs=pl.BlockSpec((1, h, bq, dh),
+                                   lambda i, j, kk, *_: (i, 0, j, 0)),
+            scratch_shapes=[pltpu.VMEM((h, bq, dh), jnp.float32)],
         ),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, tq, dh), q.dtype),
         interpret=_INTERPRET,
     )(seed_arr, *dq_args)
 
-    # --- dk/dv: grid (bh, nk, nq), q-blocks inner ---
+    # --- dk/dv: grid (b, nk, nq), q-blocks inner ---
     dkv_specs = [
-        pl.BlockSpec((1, bq, dh), lambda i, kk, j, *_: (i, j, 0)),   # q
-        pl.BlockSpec((1, bk, dh), lambda i, kk, j, *_: (i, kk, 0)),  # k
-        pl.BlockSpec((1, bk, dh), lambda i, kk, j, *_: (i, kk, 0)),  # v
+        pl.BlockSpec((1, h, bq, dh), lambda i, kk, j, *_: (i, 0, j, 0)),   # q
+        pl.BlockSpec((1, h, bk, dh), lambda i, kk, j, *_: (i, 0, kk, 0)),  # k
+        pl.BlockSpec((1, h, bk, dh), lambda i, kk, j, *_: (i, 0, kk, 0)),  # v
     ]
-    dkv_args = [q_r, k_r, v_r]
+    dkv_args = [q, k, v]
     if bias is not None:
-        bias_arr, spec = _bias_spec(bias, b, h, bq, bk, transposed=True)
-        dkv_specs.append(spec)
-        dkv_args.append(bias_arr)
+        dkv_specs.append(_bias_spec(bias, bq, bk, transposed=True))
+        dkv_args.append(bias)
         dkv_kernel = functools.partial(_dkv_kernel, scale=scale, nq=nq,
                                        p_drop=p_drop)
     else:
@@ -446,41 +449,91 @@ def _flash_bwd(scale, p_drop, q_block, k_block, res, g):
             scale=scale, nq=nq, p_drop=p_drop,
         )
     dkv_specs += [
-        pl.BlockSpec((1, bq, dh), lambda i, kk, j, *_: (i, j, 0)),  # do
-        pl.BlockSpec((1, bq, 1), lambda i, kk, j, *_: (i, j, 0)),   # lse
-        pl.BlockSpec((1, bq, 1), lambda i, kk, j, *_: (i, j, 0)),   # delta
+        pl.BlockSpec((1, h, bq, dh), lambda i, kk, j, *_: (i, 0, j, 0)),  # do
+        pl.BlockSpec((1, h, bq, 1), lambda i, kk, j, *_: (i, 0, j, 0)),   # lse
+        pl.BlockSpec((1, h, bq, 1), lambda i, kk, j, *_: (i, 0, j, 0)),   # delta
     ]
-    dkv_args += [do_r, lse, delta]
+    dkv_args += [g, lse, delta]
 
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(bh, nk, nq),
+            grid=(b, nk, nq),
             in_specs=dkv_specs,
             out_specs=[
-                pl.BlockSpec((1, bk, dh), lambda i, kk, j, *_: (i, kk, 0)),
-                pl.BlockSpec((1, bk, dh), lambda i, kk, j, *_: (i, kk, 0)),
+                pl.BlockSpec((1, h, bk, dh),
+                             lambda i, kk, j, *_: (i, 0, kk, 0)),
+                pl.BlockSpec((1, h, bk, dh),
+                             lambda i, kk, j, *_: (i, 0, kk, 0)),
             ],
             scratch_shapes=[
-                pltpu.VMEM((bk, dh), jnp.float32),
-                pltpu.VMEM((bk, dh), jnp.float32),
+                pltpu.VMEM((h, bk, dh), jnp.float32),
+                pltpu.VMEM((h, bk, dh), jnp.float32),
             ],
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tk, dh), k.dtype),
-            jax.ShapeDtypeStruct((bh, tk, dh), v.dtype),
+            jax.ShapeDtypeStruct((b, h, tk, dh), k.dtype),
+            jax.ShapeDtypeStruct((b, h, tk, dh), v.dtype),
         ],
         interpret=_INTERPRET,
     )(seed_arr, *dkv_args)
+    return dq, dk, dv
 
-    dq = dq.reshape(b, h, tq, dh)
-    dk = dk.reshape(b, h, tk, dh)
-    dv = dv.reshape(b, h, tk, dh)
-    # Bias is mask plumbing (stop_gradient in every model); zeros keeps the
-    # vjp structure without materializing a t x t gradient.
-    dbias = None if bias is None else jnp.zeros_like(bias)
+
+# ---------------------------------------------------------------------------
+# standalone custom-vjp wrapper (public API; the Program IR path uses the
+# sdpa/sdpa_grad op pair instead so the backward reuses saved stats)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def flash_attention(q, k, v, bias=None, seed=None,
+                    scale: Optional[float] = None, p_drop: float = 0.0,
+                    q_block: int = DEFAULT_Q_BLOCK,
+                    k_block: int = DEFAULT_K_BLOCK):
+    """o = dropout(softmax(q k^T * scale + bias)) v.
+
+    ``seed``: int32 scalar array driving attention dropout (ignored when
+    p_drop == 0). See the module docstring for the bias-gradient caveat.
+    """
+    out, _ = flash_attention_fwd(q, k, v, bias, seed, scale, p_drop,
+                                 q_block, k_block)
+    return out
+
+
+def _vjp_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block):
+    out, lse = flash_attention_fwd(q, k, v, bias, seed, scale, p_drop,
+                                   q_block, k_block)
+    return out, (q, k, v, bias, seed, out, lse)
+
+
+def _vjp_bwd(scale, p_drop, q_block, k_block, res, g):
+    q, k, v, bias, seed, out, lse = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    bq, bk = _pick_blocks(q.shape[1], q.shape[2], k.shape[2],
+                          q_block, k_block)
+    if _use_pallas(q.shape[2], k.shape[2], bq, bk):
+        dq, dk, dv = flash_attention_bwd(q, k, v, bias, seed, out, lse, g,
+                                         scale, p_drop, q_block, k_block)
+        # Pallas path: bias is mask plumbing, cotangent intentionally zero
+        # (see module docstring).
+        dbias = None if bias is None else jnp.zeros_like(bias)
+    else:
+        sd = seed if p_drop > 0.0 else None
+        if bias is None:
+            _, vjp = jax.vjp(
+                lambda a, b, c: _reference_attention(
+                    a, b, c, None, scale, p_drop, sd), q, k, v)
+            dq, dk, dv = vjp(g)
+            dbias = None
+        else:
+            _, vjp = jax.vjp(
+                lambda a, b, c, bb: _reference_attention(
+                    a, b, c, bb, scale, p_drop, sd), q, k, v, bias)
+            dq, dk, dv, dbias = vjp(g)
     return dq, dk, dv, dbias, _seed_cotangent(seed)
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

@@ -80,9 +80,9 @@ def test_tp_param_is_actually_sharded():
     strategy = parallel.DistributedStrategy(
         mesh, "data", parallel.transformer_rules("model"), strict=True
     )
-    assert strategy.spec_for("enc1_attn_q_colp.w") == P(None, "model")
+    assert strategy.spec_for("enc1_attn_qkv_colp.w") == P(None, "model")
     assert strategy.spec_for("enc1_attn_out_rowp.w") == P("model", None)
-    assert strategy.spec_for("enc1_attn_q_colp.w_moment1_0") == P(None, "model")
+    assert strategy.spec_for("enc1_attn_qkv_colp.w_moment1_0") == P(None, "model")
     assert strategy.spec_for("enc1_preattn_ln.scale") == P()
 
     main, startup, model = _build()
@@ -93,7 +93,7 @@ def test_tp_param_is_actually_sharded():
     feed = T.make_batch(CFG, batch=8, src_len=16, trg_len=16, seed=0)
     exe.run(compiled, feed=feed, fetch_list=[model["loss"]], scope=scope)
 
-    w = scope.find_var("enc1_attn_q_colp.w")
+    w = scope.find_var("enc1_attn_qkv_colp.w")
     assert isinstance(w, jax.Array)
     # Each shard holds half the columns on the 2-way model axis.
     shard_shape = w.sharding.shard_shape(w.shape)
