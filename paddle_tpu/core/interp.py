@@ -1,0 +1,178 @@
+"""Shared op-list interpreter used by block lowering and control-flow ops.
+
+The reference executes sub-blocks of control-flow ops by recursively invoking
+its op-by-op Executor on the sub-scope (reference:
+operators/controlflow/while_op.cc:43, conditional_block_op.cc:75). Here the
+same role is played by tracing the sub-block's registered JAX kernels into the
+enclosing XLA computation: ``exec_ops`` runs an ordered op list against a
+functional environment (name -> array), and control-flow ops call it inside
+``lax.while_loop`` / ``lax.cond`` / ``lax.scan`` closures so the whole nest
+compiles to one XLA program.
+
+AMP (bf16 activation-stream) casting is applied here so sub-blocks behave the
+same as top-level blocks under mixed precision.
+"""
+
+from __future__ import annotations
+
+import contextvars
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import autodiff
+from paddle_tpu.core.registry import GRAD_OP_SUFFIX, OpDef, get_op_def, has_op
+
+# MXU-heavy ops that run in bfloat16 under AMP: every f32 input (master
+# weights included) is cast to bf16 and the output STAYS bf16, so the whole
+# activation stream between matmuls lives in bf16 — halving HBM traffic,
+# which profiling showed was the step-time bound (casting back to f32 after
+# each matmul made every matmul bandwidth-limited). The analog of the
+# reference's AMP cast insertion (reference:
+# contrib/mixed_precision/fp16_utils.py:67), but bf16 needs no loss scaling
+# (SURVEY.md section 7 phase 4).
+AMP_OP_TYPES = {
+    "mul",
+    "matmul",
+    "conv2d",
+    "depthwise_conv2d",
+    "conv2d_transpose",
+    "scaled_dot_product_attention",
+}
+
+# Precision-following ops: when any input is already bf16, their remaining
+# f32 float inputs (params like layer-norm scale, residual branches) are
+# cast down so the op does not silently promote the stream back to f32.
+# Numerically sensitive reductions inside these kernels (layer-norm
+# mean/var) compute in f32 internally regardless of input dtype.
+AMP_FLOW_OP_TYPES = {
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "scale",
+    "dropout",
+    "relu",
+    "gelu",
+    "tanh",
+    "sigmoid",
+    "softmax",
+    "concat",
+    "stack",
+}
+# (layer_norm is absent: its kernel handles mixed dtypes itself — f32
+# internal math, x-dtype output — so no input casting is wanted.)
+
+# Slots that must stay f32 under AMP (saved numerical stats, not streams).
+AMP_KEEP_F32_SLOTS = frozenset({"Lse", "GRAD::Lse"})
+
+# Whether AMP casting is active for the block currently being traced.
+# Control-flow op computes read this so sub-blocks inherit the policy of
+# the block that contains them (a contextvar because op computes only
+# receive (ins, attrs)).
+_AMP_ACTIVE: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "paddle_tpu_amp_active", default=False
+)
+
+
+def amp_active() -> bool:
+    return _AMP_ACTIVE.get()
+
+
+def set_amp_active(flag: bool):
+    return _AMP_ACTIVE.set(bool(flag))
+
+
+def _is_f32(v):
+    return v is not None and hasattr(v, "dtype") and v.dtype == jnp.float32
+
+
+def _is_bf16(v):
+    return v is not None and hasattr(v, "dtype") and v.dtype == jnp.bfloat16
+
+
+def _amp_cast_ins(ins):
+    out = {}
+    for slot, vals in ins.items():
+        if slot in AMP_KEEP_F32_SLOTS:
+            out[slot] = list(vals)
+            continue
+        out[slot] = [
+            v.astype(jnp.bfloat16) if _is_f32(v) else v for v in vals
+        ]
+    return out
+
+
+def _amp_flow_cast_ins(ins):
+    """Cast f32 inputs to bf16 only when the op already consumes bf16."""
+    has_bf16 = any(_is_bf16(v) for vals in ins.values() for v in vals)
+    if not has_bf16:
+        return ins
+    return _amp_cast_ins(ins)
+
+
+def resolve_op_def(op_type: str) -> OpDef:
+    """Resolve an op type to its kernel, deriving ``*_grad`` on demand."""
+    if has_op(op_type):
+        return get_op_def(op_type)
+    if op_type.endswith(GRAD_OP_SUFFIX):
+        base = op_type[: -len(GRAD_OP_SUFFIX)]
+        if has_op(base):
+            fwd = get_op_def(base)
+            return OpDef(
+                type=op_type,
+                compute=autodiff.make_grad_compute(fwd),
+                needs_rng=fwd.needs_rng,
+                no_grad=True,
+            )
+    return get_op_def(op_type)  # raises with a helpful message
+
+
+def exec_ops(
+    ops,
+    env: Dict[str, Any],
+    key=None,
+    amp: Optional[bool] = None,
+    op_defs: Optional[List[OpDef]] = None,
+):
+    """Execute an op list against ``env`` in place; returns ``env``.
+
+    ``key`` is the PRNG key for this execution; per-op keys are derived by
+    folding in the op's ``forward_op_idx`` attr (so a grad op replays its
+    forward's key) or its position.
+    """
+    if amp is None:
+        amp = amp_active()
+    if op_defs is None:
+        op_defs = [resolve_op_def(op.type) for op in ops]
+    for idx, (op, opdef) in enumerate(zip(ops, op_defs)):
+        ins = {
+            slot: [env[n] if n else None for n in names]
+            for slot, names in op.inputs.items()
+        }
+        kwargs = {}
+        if opdef.needs_rng:
+            fold = op.attrs.get("forward_op_idx", idx)
+            kwargs["rng"] = (
+                jax.random.fold_in(key, fold) if key is not None else None
+            )
+        base_type = (
+            op.type[: -len(GRAD_OP_SUFFIX)]
+            if op.type.endswith(GRAD_OP_SUFFIX)
+            else op.type
+        )
+        if amp and base_type in AMP_OP_TYPES:
+            ins = _amp_cast_ins(ins)
+        elif amp and base_type in AMP_FLOW_OP_TYPES:
+            ins = _amp_flow_cast_ins(ins)
+        outs = opdef.compute(ins, dict(op.attrs), **kwargs)
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot, [])
+            for i, n in enumerate(names):
+                if not n:
+                    continue
+                v = vals[i] if i < len(vals) else None
+                if v is not None:
+                    env[n] = v
+    return env

@@ -31,94 +31,17 @@ from paddle_tpu.framework import Block, Program
 # Ops handled by the lowering itself rather than a registered kernel.
 _STRUCTURAL_OPS = ("feed", "fetch")
 
-# MXU-heavy ops that run in bfloat16 under AMP: every f32 input (master
-# weights included) is cast to bf16 and the output STAYS bf16, so the whole
-# activation stream between matmuls lives in bf16 — halving HBM traffic,
-# which profiling showed was the step-time bound (casting back to f32 after
-# each matmul made every matmul bandwidth-limited). The analog of the
-# reference's AMP cast insertion (reference:
-# contrib/mixed_precision/fp16_utils.py:67), but bf16 needs no loss scaling
-# (SURVEY.md section 7 phase 4).
-AMP_OP_TYPES = {
-    "mul",
-    "matmul",
-    "conv2d",
-    "depthwise_conv2d",
-    "conv2d_transpose",
-    "scaled_dot_product_attention",
-}
-
-# Precision-following ops: when any input is already bf16, their remaining
-# f32 float inputs (params like layer-norm scale, residual branches) are
-# cast down so the op does not silently promote the stream back to f32.
-# Numerically sensitive reductions inside these kernels (layer-norm
-# mean/var) compute in f32 internally regardless of input dtype.
-AMP_FLOW_OP_TYPES = {
-    "elementwise_add",
-    "elementwise_sub",
-    "elementwise_mul",
-    "elementwise_div",
-    "scale",
-    "dropout",
-    "relu",
-    "gelu",
-    "tanh",
-    "sigmoid",
-    "softmax",
-    "concat",
-    "stack",
-}
-# (layer_norm is absent: its kernel handles mixed dtypes itself — f32
-# internal math, x-dtype output — so no input casting is wanted.)
-
-
-def _is_f32(v):
-    return v is not None and hasattr(v, "dtype") and v.dtype == jnp.float32
-
-
-def _is_bf16(v):
-    return v is not None and hasattr(v, "dtype") and v.dtype == jnp.bfloat16
-
-
-# Slots that must stay f32 under AMP (saved numerical stats, not streams).
-AMP_KEEP_F32_SLOTS = frozenset({"Lse", "GRAD::Lse"})
-
-
-def _amp_cast_ins(ins):
-    out = {}
-    for slot, vals in ins.items():
-        if slot in AMP_KEEP_F32_SLOTS:
-            out[slot] = list(vals)
-            continue
-        out[slot] = [
-            v.astype(jnp.bfloat16) if _is_f32(v) else v for v in vals
-        ]
-    return out
-
-
-def _amp_flow_cast_ins(ins):
-    """Cast f32 inputs to bf16 only when the op already consumes bf16."""
-    has_bf16 = any(_is_bf16(v) for vals in ins.values() for v in vals)
-    if not has_bf16:
-        return ins
-    return _amp_cast_ins(ins)
-
-
-def resolve_op_def(op_type: str) -> OpDef:
-    """Resolve an op type to its kernel, deriving ``*_grad`` on demand."""
-    if has_op(op_type):
-        return get_op_def(op_type)
-    if op_type.endswith(GRAD_OP_SUFFIX):
-        base = op_type[: -len(GRAD_OP_SUFFIX)]
-        if has_op(base):
-            fwd = get_op_def(base)
-            return OpDef(
-                type=op_type,
-                compute=autodiff.make_grad_compute(fwd),
-                needs_rng=fwd.needs_rng,
-                no_grad=True,
-            )
-    return get_op_def(op_type)  # raises with a helpful message
+# AMP policy + the op-list interpreter live in core/interp.py (shared with
+# control-flow ops, which execute sub-blocks inside lax closures). Re-exported
+# here for compatibility.
+from paddle_tpu.core.interp import (  # noqa: E402,F401
+    AMP_FLOW_OP_TYPES,
+    AMP_KEEP_F32_SLOTS,
+    AMP_OP_TYPES,
+    exec_ops,
+    resolve_op_def,
+    set_amp_active,
+)
 
 
 @dataclasses.dataclass
@@ -202,33 +125,13 @@ def lower_block(
         env: Dict[str, Any] = {}
         env.update(state)
         env.update(feeds)
-        for idx, (op, opdef) in enumerate(zip(ops, op_defs)):
-            ins = {
-                slot: [env[n] if n else None for n in names]
-                for slot, names in op.inputs.items()
-            }
-            kwargs = {}
-            if opdef.needs_rng:
-                fold = op.attrs.get("forward_op_idx", idx)
-                kwargs["rng"] = jax.random.fold_in(key, fold)
-            base_type = (
-                op.type[: -len(GRAD_OP_SUFFIX)]
-                if op.type.endswith(GRAD_OP_SUFFIX)
-                else op.type
-            )
-            if amp and base_type in AMP_OP_TYPES:
-                ins = _amp_cast_ins(ins)
-            elif amp and base_type in AMP_FLOW_OP_TYPES:
-                ins = _amp_flow_cast_ins(ins)
-            outs = opdef.compute(ins, dict(op.attrs), **kwargs)
-            for slot, names in op.outputs.items():
-                vals = outs.get(slot, [])
-                for i, n in enumerate(names):
-                    if not n:
-                        continue
-                    v = vals[i] if i < len(vals) else None
-                    if v is not None:
-                        env[n] = v
+        tok = set_amp_active(amp)
+        try:
+            exec_ops(ops, env, key=key, amp=amp, op_defs=op_defs)
+        finally:
+            from paddle_tpu.core.interp import _AMP_ACTIVE
+
+            _AMP_ACTIVE.reset(tok)
         fetches = [env[n] for n in fetch_names]
         new_state = {n: env[n] for n in state_out}
         return fetches, new_state

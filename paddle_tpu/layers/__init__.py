@@ -3,6 +3,16 @@
 from paddle_tpu.layers.io import *  # noqa: F401,F403
 from paddle_tpu.layers.nn import *  # noqa: F401,F403
 from paddle_tpu.layers.tensor import *  # noqa: F401,F403
+from paddle_tpu.layers.rnn import *  # noqa: F401,F403
+from paddle_tpu.layers.control_flow import (  # noqa: F401
+    StaticRNN,
+    Switch,
+    While,
+    array_fill,
+    array_write_step,
+    cond,
+    while_loop,
+)
 from paddle_tpu.layers import learning_rate_scheduler  # noqa: F401
 from paddle_tpu.layers.learning_rate_scheduler import (  # noqa: F401
     cosine_decay,

@@ -1,0 +1,125 @@
+"""Recurrent layers: dynamic_lstm / dynamic_gru / lstm_lm helpers.
+
+Reference API: python/paddle/fluid/layers/nn.py (dynamic_lstm:443,
+dynamic_gru:743). Like the reference, the input-to-hidden projection is NOT
+part of these layers — callers project with ``fc`` first (one big MXU matmul
+over all timesteps), and the layer scans only the recurrent part. Input is a
+padded dense batch ``[B, T, 4H|3H]`` (+ optional lengths) instead of a LoD
+tensor.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.framework import Variable
+from paddle_tpu.layer_helper import LayerHelper
+
+__all__ = ["dynamic_lstm", "dynamic_gru"]
+
+
+def dynamic_lstm(
+    input: Variable,
+    size: int,
+    length: Variable = None,
+    h_0: Variable = None,
+    c_0: Variable = None,
+    param_attr=None,
+    bias_attr=None,
+    use_peepholes: bool = False,
+    is_reverse: bool = False,
+    gate_activation: str = "sigmoid",
+    cell_activation: str = "tanh",
+    candidate_activation: str = "tanh",
+    dtype: str = "float32",
+    name=None,
+):
+    """LSTM over ``input`` [B, T, 4*H] (pre-projected gates); returns
+    (hidden [B, T, H], cell [B, T, H])."""
+    if use_peepholes:
+        raise NotImplementedError(
+            "peephole connections are not supported (rarely used; the "
+            "reference defaults them on but every benchmark model disables "
+            "them)"
+        )
+    helper = LayerHelper("lstm", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    h = size // 4
+    weight = helper.create_parameter(param_attr, shape=[h, size], dtype=dtype)
+    bias = helper.create_parameter(
+        bias_attr, shape=[size], dtype=dtype, is_bias=True
+    )
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": input, "Weight": weight}
+    if bias is not None:
+        inputs["Bias"] = bias
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(
+        "lstm",
+        inputs=inputs,
+        outputs={
+            "Hidden": hidden,
+            "Cell": cell,
+            "LastH": last_h,
+            "LastC": last_c,
+        },
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    return hidden, cell
+
+
+def dynamic_gru(
+    input: Variable,
+    size: int,
+    length: Variable = None,
+    h_0: Variable = None,
+    param_attr=None,
+    bias_attr=None,
+    is_reverse: bool = False,
+    gate_activation: str = "sigmoid",
+    candidate_activation: str = "tanh",
+    dtype: str = "float32",
+    name=None,
+):
+    """GRU over ``input`` [B, T, 3*H] (pre-projected gates); returns
+    hidden [B, T, H]."""
+    helper = LayerHelper("gru", name=name, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    h = size
+    weight = helper.create_parameter(
+        param_attr, shape=[h, 3 * h], dtype=dtype
+    )
+    bias = helper.create_parameter(
+        bias_attr, shape=[3 * h], dtype=dtype, is_bias=True
+    )
+    hidden = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": input, "Weight": weight}
+    if bias is not None:
+        inputs["Bias"] = bias
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(
+        "gru",
+        inputs=inputs,
+        outputs={"Hidden": hidden, "LastH": last_h},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "activation": candidate_activation,
+        },
+    )
+    return hidden

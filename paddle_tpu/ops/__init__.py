@@ -10,9 +10,11 @@ custom grad maker.
 from paddle_tpu.ops import (  # noqa: F401
     activation_ops,
     attention_ops,
+    control_flow_ops,
     math_ops,
     nn_ops,
     optimizer_ops,
+    rnn_ops,
     sequence_ops,
     tensor_ops,
 )

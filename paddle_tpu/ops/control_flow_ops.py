@@ -1,0 +1,175 @@
+"""Control-flow ops: ``while``, ``cond``, ``scan``.
+
+TPU-native redesign of the reference's control-flow operators
+(reference: operators/controlflow/while_op.cc:43,
+operators/controlflow/conditional_block_op.cc:75,
+operators/recurrent_op.cc:250). The reference interprets a sub-block with a
+recursively invoked executor over per-iteration scopes; on TPU the sub-block
+is *traced* into the enclosing XLA computation as the closure of a
+structural primitive:
+
+- ``while``  -> ``lax.while_loop``  (data-dependent trip count; no gradient,
+  matching XLA's non-differentiable While — training loops use ``scan``)
+- ``cond``   -> ``lax.cond``        (differentiable via its linearization)
+- ``scan``   -> ``lax.scan``        (fixed trip count; differentiable — this
+  is the training-time recurrence primitive, replacing RecurrentOp's
+  save-everything tape with XLA's scan transpose)
+
+Conventions shared by the three ops: the sub-block reads/writes a functional
+env (name -> array). Values crossing the block boundary are *op inputs*
+(slots ``X``/``Init``/``Captured``), never Python closure captures, so state
+analysis (core/lowering.py:analyze_state) and autodiff see them. Name lists
+mapping slot positions to env names ride in attrs.
+
+PRNG: each op folds the incoming key with the iteration counter so stochastic
+sub-ops (dropout) draw fresh randomness per step, and the derived grad op
+replays the same keys (attrs carry ``forward_op_idx``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core import interp
+from paddle_tpu.core.registry import register_op
+
+
+def _scalar_bool(x):
+    return jnp.reshape(jnp.asarray(x), ()).astype(jnp.bool_)
+
+
+def _sub_env(cap_names, cap_vals):
+    env = {}
+    for n, v in zip(cap_names, cap_vals):
+        env[n] = v
+    return env
+
+
+@register_op("while", no_grad=True, needs_rng=True)
+def _while(ins, attrs, rng=None):
+    """Run ``sub_block`` while the condition var is true.
+
+    attrs: sub_block, carry_names (env names of loop-carried values, first
+    updated by each iteration), cond_name (env name of the bool scalar the
+    sub-block must refresh each iteration), captured_names.
+    inputs: Condition=[cond0], X=carried initial values, Captured=read-only.
+    outputs: Out=final carried values (same order as carry_names).
+    """
+    sub = attrs["sub_block"]
+    carry_names = list(attrs["carry_names"])
+    cond_name = attrs["cond_name"]
+    cap_names = list(attrs.get("captured_names", []))
+    cap_vals = list(ins.get("Captured", []))
+    amp = interp.amp_active()
+    sub_ops = list(sub.ops)
+
+    def cond_fun(carry):
+        return _scalar_bool(carry[1])
+
+    def body_fun(carry):
+        i, cond_val = carry[0], carry[1]
+        env = _sub_env(cap_names, cap_vals)
+        env[cond_name] = cond_val
+        env.update(zip(carry_names, carry[2:]))
+        key = jax.random.fold_in(rng, i) if rng is not None else None
+        interp.exec_ops(sub_ops, env, key=key, amp=amp)
+        return (i + 1, _scalar_bool(env[cond_name])) + tuple(
+            env[n] for n in carry_names
+        )
+
+    init = (
+        jnp.zeros((), jnp.int32),
+        _scalar_bool(ins["Condition"][0]),
+    ) + tuple(ins.get("X", []))
+    final = lax.while_loop(cond_fun, body_fun, init)
+    return {"Out": list(final[2:]), "CondOut": [final[1]], "Steps": [final[0]]}
+
+
+@register_op("cond", diff_inputs=("Captured",), needs_rng=True)
+def _cond(ins, attrs, rng=None):
+    """Select between two sub-blocks on a scalar predicate.
+
+    attrs: true_block, false_block, true_out_names, false_out_names,
+    captured_names. Both branches read the same Captured values; outputs are
+    paired positionally (``Out[i]`` = true_out_names[i] / false_out_names[i]).
+    """
+    true_block, false_block = attrs["true_block"], attrs["false_block"]
+    t_outs = list(attrs["true_out_names"])
+    f_outs = list(attrs["false_out_names"])
+    cap_names = list(attrs.get("captured_names", []))
+    amp = interp.amp_active()
+    pred = _scalar_bool(ins["Cond"][0])
+    t_key = jax.random.fold_in(rng, 0) if rng is not None else None
+    f_key = jax.random.fold_in(rng, 1) if rng is not None else None
+
+    def make_branch(block, out_names, key):
+        ops_ = list(block.ops)
+
+        def branch(cap_vals):
+            env = _sub_env(cap_names, cap_vals)
+            interp.exec_ops(ops_, env, key=key, amp=amp)
+            return tuple(env[n] for n in out_names)
+
+        return branch
+
+    outs = lax.cond(
+        pred,
+        make_branch(true_block, t_outs, t_key),
+        make_branch(false_block, f_outs, f_key),
+        tuple(ins.get("Captured", [])),
+    )
+    return {"Out": list(outs)}
+
+
+@register_op(
+    "scan", diff_inputs=("X", "Init", "Captured"), needs_rng=True
+)
+def _scan(ins, attrs, rng=None):
+    """Fixed-length recurrence: run ``sub_block`` over the leading axis.
+
+    attrs: sub_block, x_names (env names of per-step slices of X),
+    state_in_names/state_out_names (parallel: carried state env names read /
+    written per step), y_names (env names stacked into Y), captured_names,
+    reverse, n_steps (required when X is empty).
+    inputs: X=[T, ...] scanned tensors (time-major), Init=initial states,
+    Captured=read-only values (parameters live here so gradients flow).
+    outputs: Y=stacked per-step outputs [T, ...], FinalState=final states.
+
+    Differentiable: the derived ``scan_grad`` op vjps through ``lax.scan``,
+    which XLA transposes into the reverse-time accumulation the reference
+    hand-writes in RecurrentGradOp (reference: operators/recurrent_op.cc:250).
+    """
+    sub = attrs["sub_block"]
+    x_names = list(attrs.get("x_names", []))
+    s_in = list(attrs.get("state_in_names", []))
+    s_out = list(attrs.get("state_out_names", []))
+    y_names = list(attrs.get("y_names", []))
+    cap_names = list(attrs.get("captured_names", []))
+    reverse = bool(attrs.get("reverse", False))
+    xs = list(ins.get("X", []))
+    init = list(ins.get("Init", []))
+    cap_vals = list(ins.get("Captured", []))
+    amp = interp.amp_active()
+    sub_ops = list(sub.ops)
+
+    if xs:
+        n_steps = jnp.shape(xs[0])[0]
+    else:
+        n_steps = int(attrs["n_steps"])
+
+    def body(carry, step):
+        i, xt = step
+        env = _sub_env(cap_names, cap_vals)
+        env.update(zip(s_in, carry))
+        env.update(zip(x_names, xt))
+        key = jax.random.fold_in(rng, i) if rng is not None else None
+        interp.exec_ops(sub_ops, env, key=key, amp=amp)
+        new_carry = tuple(env[n] for n in s_out)
+        ys = tuple(env[n] for n in y_names)
+        return new_carry, ys
+
+    steps = (jnp.arange(n_steps, dtype=jnp.int32), tuple(xs))
+    final, ys = lax.scan(body, tuple(init), steps, reverse=reverse)
+    return {"Y": list(ys), "FinalState": list(final)}

@@ -190,7 +190,9 @@ def _squared_l2_norm(ins, attrs):
 
 @register_op("increment")
 def _increment(ins, attrs):
-    return {"Out": [_x(ins) + attrs.get("step", 1.0)]}
+    x = _x(ins)
+    step = jnp.asarray(attrs.get("step", 1.0)).astype(x.dtype)
+    return {"Out": [x + step]}
 
 
 @register_op("isfinite", no_grad=True, doc="all-finite check (FLAGS_check_nan_inf analog)")

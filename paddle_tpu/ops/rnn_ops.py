@@ -1,0 +1,168 @@
+"""Fused recurrent ops: lstm, gru.
+
+TPU-native redesign of the reference's recurrent operators
+(reference: operators/lstm_op.cc, operators/gru_op.cc,
+operators/math/lstm_compute.cc). The reference consumes a LoD tensor whose
+rows are sorted/packed per time step; here the layout is a padded dense batch
+``[B, T, ...]`` plus an optional ``Length [B]`` vector (SURVEY.md section 5).
+
+Performance shape: the input-to-hidden projection (the big matmul, ``x @ Wx``
+for all timesteps at once) is done OUTSIDE the op by an fc layer — one
+``[B*T, D] x [D, 4H]`` MXU matmul — and the op itself scans only the
+hidden-to-hidden recurrence (``h @ Wh``, unavoidable sequential part),
+mirroring how the reference splits input projection out of lstm_op
+(reference: python/paddle/fluid/layers/nn.py dynamic_lstm docs). The scan is
+differentiable, so grads come from XLA's scan transpose.
+
+Padding semantics: steps at or beyond a row's length propagate state
+unchanged and emit zero outputs, matching LoD sequence termination.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+
+
+def _act(name):
+    return {
+        "sigmoid": lambda x: jax.nn.sigmoid(x),
+        "tanh": jnp.tanh,
+        "relu": lambda x: jnp.maximum(x, 0),
+        "identity": lambda x: x,
+    }[name]
+
+
+import jax  # noqa: E402  (used by _act closures)
+
+
+def _length_mask(ins, b, t, dtype):
+    length = ins.get("Length")
+    if not length or length[0] is None:
+        return None
+    ln = length[0]
+    if jnp.ndim(ln) > 1:
+        ln = jnp.squeeze(ln, -1)
+    return (jnp.arange(t)[None, :] < ln[:, None]).astype(dtype)  # [B, T]
+
+
+@register_op("lstm", diff_inputs=("Input", "Weight", "Bias", "H0", "C0"))
+def _lstm(ins, attrs):
+    """Fused LSTM over a projected input stream.
+
+    inputs: Input [B,T,4H] (= x @ Wx + b, gate order i,f,c,o), Weight [H,4H]
+    (hidden-to-hidden), Bias [4H] optional, H0/C0 [B,H] optional,
+    Length [B] optional.
+    outputs: Hidden [B,T,H], Cell [B,T,H], LastH [B,H], LastC [B,H].
+    attrs: is_reverse, gate_activation, cell_activation,
+    candidate_activation, forget_bias.
+    """
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    b_, t_, four_h = x.shape
+    h_dim = four_h // 4
+    h0 = ins.get("H0", [None])[0]
+    c0 = ins.get("C0", [None])[0]
+    if h0 is None:
+        h0 = jnp.zeros((b_, h_dim), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((b_, h_dim), x.dtype)
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    forget_bias = attrs.get("forget_bias", 0.0)
+    reverse = bool(attrs.get("is_reverse", False))
+
+    mask = _length_mask(ins, b_, t_, x.dtype)  # [B,T] or None
+    xt = jnp.swapaxes(x, 0, 1)  # [T,B,4H]
+    if bias is not None:
+        xt = xt + bias
+    mt = jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None else None
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        if mt is None:
+            g, m = inp, None
+        else:
+            g, m = inp
+        g = g + jnp.dot(h_prev, w)
+        i, f, c_hat, o = jnp.split(g, 4, axis=-1)
+        i = gate_act(i)
+        f = gate_act(f + forget_bias)
+        o = gate_act(o)
+        c = f * c_prev + i * cand_act(c_hat)
+        h = o * cell_act(c)
+        if m is not None:
+            c = m * c + (1 - m) * c_prev
+            h_out = m * h
+            h = m * h + (1 - m) * h_prev
+        else:
+            h_out = h
+        return (h, c), (h_out, c)
+
+    xs = xt if mt is None else (xt, mt)
+    (h_last, c_last), (hs, cs) = lax.scan(
+        step, (h0, c0), xs, reverse=reverse
+    )
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    return {
+        "Hidden": [hidden],
+        "Cell": [cell],
+        "LastH": [h_last],
+        "LastC": [c_last],
+    }
+
+
+@register_op("gru", diff_inputs=("Input", "Weight", "Bias", "H0"))
+def _gru(ins, attrs):
+    """Fused GRU over a projected input stream.
+
+    inputs: Input [B,T,3H] (= x @ Wx, gate order u,r,c), Weight [H,3H],
+    Bias [3H] optional, H0 [B,H] optional, Length [B] optional.
+    outputs: Hidden [B,T,H], LastH [B,H].
+    attrs: is_reverse, gate_activation (u/r), activation (candidate).
+    """
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    b_, t_, three_h = x.shape
+    h_dim = three_h // 3
+    h0 = ins.get("H0", [None])[0]
+    if h0 is None:
+        h0 = jnp.zeros((b_, h_dim), x.dtype)
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+    reverse = bool(attrs.get("is_reverse", False))
+
+    w_ur = w[:, : 2 * h_dim]  # [H, 2H]
+    w_c = w[:, 2 * h_dim :]  # [H, H]
+    mask = _length_mask(ins, b_, t_, x.dtype)
+    xt = jnp.swapaxes(x, 0, 1)
+    if bias is not None:
+        xt = xt + bias
+    mt = jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None else None
+
+    def step(carry, inp):
+        h_prev = carry
+        if mt is None:
+            g, m = inp, None
+        else:
+            g, m = inp
+        g_ur = g[..., : 2 * h_dim] + jnp.dot(h_prev, w_ur)
+        u, r = jnp.split(gate_act(g_ur), 2, axis=-1)
+        c = cand_act(g[..., 2 * h_dim :] + jnp.dot(r * h_prev, w_c))
+        h = u * h_prev + (1 - u) * c
+        if m is not None:
+            h_out = m * h
+            h = m * h + (1 - m) * h_prev
+        else:
+            h_out = h
+        return h, h_out
+
+    xs = xt if mt is None else (xt, mt)
+    h_last, hs = lax.scan(step, h0, xs, reverse=reverse)
+    return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}

@@ -295,3 +295,36 @@ def _pad(ins, attrs):
 @register_op("tile")
 def _tile(ins, attrs):
     return {"Out": [jnp.tile(_x(ins), attrs["repeat_times"])]}
+
+
+@register_op("dynamic_update", diff_inputs=("X", "Value"))
+def _dynamic_update(ins, attrs):
+    """Write Value at dynamic position Index along axis 0 of X.
+
+    Static-shape stand-in for the reference's LoDTensorArray write
+    (reference: operators/controlflow/tensor_array_read_write_op.cc):
+    the "array" is a preallocated [maxlen, ...] dense tensor.
+    """
+    import jax.lax as lax
+
+    x = _x(ins)
+    idx = jnp.reshape(ins["Index"][0], ()).astype(jnp.int32)
+    v = ins["Value"][0]
+    v = jnp.expand_dims(v, 0).astype(x.dtype)
+    zero = jnp.zeros((), jnp.int32)
+    starts = (idx,) + (zero,) * (x.ndim - 1)
+    return {"Out": [lax.dynamic_update_slice(x, v, starts)]}
+
+
+@register_op("dynamic_slice", diff_inputs=("X",))
+def _dynamic_slice(ins, attrs):
+    """Read the [Index] slice along axis 0 of X (LoDTensorArray read)."""
+    import jax.lax as lax
+
+    x = _x(ins)
+    idx = jnp.reshape(ins["Index"][0], ()).astype(jnp.int32)
+    sizes = (1,) + tuple(x.shape[1:])
+    zero = jnp.zeros((), jnp.int32)
+    starts = (idx,) + (zero,) * (x.ndim - 1)
+    out = lax.dynamic_slice(x, starts, sizes)
+    return {"Out": [jnp.squeeze(out, 0)]}

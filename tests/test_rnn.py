@@ -1,0 +1,165 @@
+"""Fused lstm/gru ops + dynamic_lstm/dynamic_gru layers.
+
+Parity model: numpy step-by-step recurrence (the reference validates
+lstm_op against a python reference the same way,
+reference: tests/unittests/test_lstm_op.py).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm(x_proj, w, bias, h0, c0, lengths=None):
+    """x_proj: [B,T,4H] pre-projected gates; returns hidden [B,T,H]."""
+    b, t, four_h = x_proj.shape
+    h_dim = four_h // 4
+    h, c = h0.copy(), c0.copy()
+    out = np.zeros((b, t, h_dim), np.float32)
+    for i in range(t):
+        g = x_proj[:, i] + bias + h @ w
+        ii, ff, cc, oo = np.split(g, 4, axis=-1)
+        ii, ff, oo = _sigmoid(ii), _sigmoid(ff), _sigmoid(oo)
+        c_new = ff * c + ii * np.tanh(cc)
+        h_new = oo * np.tanh(c_new)
+        if lengths is not None:
+            m = (i < lengths)[:, None].astype(np.float32)
+            c = m * c_new + (1 - m) * c
+            out[:, i] = (m * h_new)[:, :]
+            h = m * h_new + (1 - m) * h
+        else:
+            h, c = h_new, c_new
+            out[:, i] = h
+    return out
+
+
+def test_lstm_matches_numpy():
+    b, t, h_dim = 3, 7, 4
+    rs = np.random.RandomState(0)
+    xp = rs.randn(b, t, 4 * h_dim).astype(np.float32) * 0.5
+    wv = rs.randn(h_dim, 4 * h_dim).astype(np.float32) * 0.3
+    bv = rs.randn(4 * h_dim).astype(np.float32) * 0.1
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(b, t, 4 * h_dim), dtype="float32"
+        )
+        hidden, cell = layers.dynamic_lstm(x, size=4 * h_dim, name="l0")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    from paddle_tpu.executor import global_scope
+
+    params = [p.name for p in main.global_block().all_parameters()]
+    wname = [p for p in params if ".w" in p][0]
+    bname = [p for p in params if ".b" in p][0]
+    global_scope().set(wname, wv)
+    global_scope().set(bname, bv)
+    (hv,) = exe.run(main, feed={"x": xp}, fetch_list=[hidden])
+    expect = _np_lstm(
+        xp, wv, bv, np.zeros((b, h_dim), np.float32),
+        np.zeros((b, h_dim), np.float32),
+    )
+    np.testing.assert_allclose(hv, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_length_masking():
+    b, t, h_dim = 2, 6, 3
+    rs = np.random.RandomState(1)
+    xp = rs.randn(b, t, 4 * h_dim).astype(np.float32)
+    lengths = np.array([4, 6], np.int32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(b, t, 4 * h_dim), dtype="float32"
+        )
+        ln = main.global_block().create_var(
+            name="ln", shape=(b,), dtype="int32"
+        )
+        hidden, _ = layers.dynamic_lstm(
+            x, size=4 * h_dim, length=ln, bias_attr=False, name="l1"
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    (hv,) = exe.run(
+        main, feed={"x": xp, "ln": lengths}, fetch_list=[hidden]
+    )
+    # Padded steps emit zeros.
+    assert np.all(hv[0, 4:] == 0)
+    assert np.any(hv[1, 4:] != 0)
+
+
+def test_gru_shapes_and_grad():
+    b, t, h_dim = 2, 5, 4
+    rs = np.random.RandomState(2)
+    xp = rs.randn(b, t, 3 * h_dim).astype(np.float32)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(b, t, 3 * h_dim), dtype="float32",
+            stop_gradient=False,
+        )
+        hidden = layers.dynamic_gru(x, size=h_dim, name="g0")
+        loss = layers.reduce_sum(hidden)
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    hv, gx = exe.run(
+        main, feed={"x": xp}, fetch_list=[hidden, "x@GRAD"]
+    )
+    assert hv.shape == (b, t, h_dim)
+    assert gx.shape == xp.shape
+    assert np.abs(gx).sum() > 0
+    wname = [p.name for p in main.global_block().all_parameters()
+             if ".w" in p.name][0]
+    assert main.global_block().has_var(wname + "@GRAD")
+
+
+def test_lstm_language_model_trains():
+    """Char-level LSTM LM: embed -> fc(4H) -> lstm -> fc(V); loss drops.
+
+    This is the `stacked_dynamic_lstm` benchmark family's core path
+    (reference: benchmark/fluid/models/stacked_dynamic_lstm.py).
+    """
+    b, t, v, h_dim = 8, 12, 30, 16
+    rs = np.random.RandomState(3)
+    tokens = rs.randint(0, v, size=(b, t + 1)).astype(np.int64)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(b, t), dtype="int64"
+        )
+        y = main.global_block().create_var(
+            name="y", shape=(b, t), dtype="int64"
+        )
+        emb = layers.embedding(x, size=[v, h_dim])
+        proj = layers.fc(emb, size=4 * h_dim, num_flatten_dims=2,
+                         bias_attr=False)
+        hidden, _ = layers.dynamic_lstm(proj, size=4 * h_dim)
+        logits = layers.fc(hidden, size=v, num_flatten_dims=2)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(
+                logits, layers.unsqueeze(y, [2])
+            )
+        )
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _ in range(40):
+        (lv,) = exe.run(
+            main,
+            feed={"x": tokens[:, :-1], "y": tokens[:, 1:]},
+            fetch_list=[loss],
+        )
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
