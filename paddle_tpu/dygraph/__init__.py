@@ -1,1 +1,28 @@
-from paddle_tpu.dygraph import base  # noqa: F401
+"""Dygraph (imperative/eager) mode
+(reference: python/paddle/fluid/dygraph/)."""
+
+from paddle_tpu.dygraph import nn  # noqa: F401
+from paddle_tpu.dygraph.base import (  # noqa: F401
+    _in_dygraph_mode,
+    enabled,
+    guard,
+    no_grad,
+    to_variable,
+)
+from paddle_tpu.dygraph.checkpoint import load_dygraph, save_dygraph  # noqa: F401
+from paddle_tpu.dygraph.layers import Layer  # noqa: F401
+from paddle_tpu.dygraph.nn import (  # noqa: F401
+    FC,
+    BatchNorm,
+    Conv2D,
+    Conv2DTranspose,
+    Dropout,
+    Embedding,
+    GroupNorm,
+    GRUUnit,
+    LayerNorm,
+    Linear,
+    Pool2D,
+    PRelu,
+)
+from paddle_tpu.dygraph.tracer import Tracer, VarBase, get_tracer  # noqa: F401

@@ -1,6 +1,18 @@
-"""Dygraph (eager) mode base (reference: python/paddle/fluid/dygraph/base.py:29)."""
+"""Dygraph (eager) mode entry points
+(reference: python/paddle/fluid/dygraph/base.py:29).
+
+``guard()`` switches the process into imperative mode: layers and optimizers
+check ``_in_dygraph_mode()`` and route through the eager Tracer instead of
+appending ops to the default Program.
+"""
+
+from __future__ import annotations
 
 import contextlib
+
+import numpy as np
+
+from paddle_tpu.dygraph.tracer import VarBase, get_tracer
 
 _in_dygraph = False
 
@@ -9,8 +21,13 @@ def _in_dygraph_mode() -> bool:
     return _in_dygraph
 
 
+enabled = _in_dygraph_mode
+
+
 @contextlib.contextmanager
 def guard(place=None):
+    """Enter dygraph mode. ``place`` is accepted for API parity; device
+    placement is JAX's default-device policy (TPU when present)."""
     global _in_dygraph
     old = _in_dygraph
     _in_dygraph = True
@@ -18,3 +35,21 @@ def guard(place=None):
         yield
     finally:
         _in_dygraph = old
+
+
+def to_variable(value, name=None, block=None) -> VarBase:
+    """numpy / scalar / VarBase -> eager VarBase
+    (reference: dygraph/base.py ``to_variable``)."""
+    if isinstance(value, VarBase):
+        return value
+    arr = np.asarray(value)
+    # Data (as opposed to parameters) defaults to no-grad, matching the
+    # reference where only parameters/intermediates track gradients unless
+    # stop_gradient is cleared explicitly.
+    return VarBase(arr, name=name, stop_gradient=True)
+
+
+@contextlib.contextmanager
+def no_grad():
+    with get_tracer().no_grad():
+        yield

@@ -1,0 +1,26 @@
+"""Eager save/load of Layer state dicts
+(reference: python/paddle/fluid/dygraph/checkpoint.py)."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import numpy as np
+
+
+def save_dygraph(state_dict: Dict[str, np.ndarray], model_path: str):
+    """Save a ``Layer.state_dict()`` (or optimizer state) to ``<path>.npz``."""
+    if not state_dict:
+        raise ValueError("save_dygraph: empty state dict")
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    np.savez(model_path + ".npz", **{k: np.asarray(v) for k, v in state_dict.items()})
+
+
+def load_dygraph(model_path: str) -> Dict[str, np.ndarray]:
+    """Load a state dict saved by ``save_dygraph``."""
+    path = model_path if model_path.endswith(".npz") else model_path + ".npz"
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}

@@ -1,0 +1,177 @@
+"""Eager ``Layer`` base class (reference: python/paddle/fluid/dygraph/layers.py:31).
+
+Parameters are eager ``VarBase`` values created by running the same
+initializer ops the static graph uses (traced into a throwaway block and
+executed through the shared interpreter), so eager and static models
+initialize identically given the same seed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from paddle_tpu import unique_name
+from paddle_tpu.core.interp import exec_ops
+from paddle_tpu.dygraph.tracer import VarBase, get_tracer
+from paddle_tpu.framework import Program
+from paddle_tpu.initializer import (
+    ConstantInitializer,
+    Initializer,
+    XavierInitializer,
+)
+from paddle_tpu.param_attr import ParamAttr
+
+_init_counter = [0]
+
+
+def eager_initialize(shape, dtype, initializer: Initializer, seed=None):
+    """Run a static-graph initializer eagerly: trace its fill op into a
+    throwaway block, execute through the shared interpreter."""
+    prog = Program()
+    block = prog.global_block()
+    var = block.create_var(name="param", shape=list(shape), dtype=dtype)
+    initializer(var, block)
+    _init_counter[0] += 1
+    key = jax.random.PRNGKey(
+        seed if seed is not None else _init_counter[0]
+    )
+    env = exec_ops(block.ops, {}, key=key, amp=False)
+    return env["param"]
+
+
+class Layer:
+    """Composable eager module (reference: dygraph/layers.py:31)."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower()
+        )
+        self._dtype = dtype
+        self._parameters: Dict[str, VarBase] = {}
+        self._sub_layers: Dict[str, "Layer"] = {}
+        self.training = True
+
+    def full_name(self) -> str:
+        return self._full_name
+
+    # --- modes ---
+
+    def train(self):
+        self.training = True
+        for l in self._sub_layers.values():
+            l.train()
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self._sub_layers.values():
+            l.eval()
+        return self
+
+    # --- parameter management ---
+
+    def create_parameter(
+        self,
+        attr,
+        shape,
+        dtype="float32",
+        is_bias: bool = False,
+        default_initializer: Optional[Initializer] = None,
+        suffix: Optional[str] = None,
+    ) -> Optional[VarBase]:
+        attr = ParamAttr._to_attr(attr)
+        if attr is False or (attr is not None and attr.name is False):
+            return None
+        name = (attr.name if attr else None) or unique_name.generate(
+            f"{self._full_name}.{suffix or ('b' if is_bias else 'w')}"
+        )
+        init = (attr.initializer if attr else None) or default_initializer
+        if init is None:
+            init = ConstantInitializer(0.0) if is_bias else XavierInitializer()
+        value = eager_initialize(shape, dtype, init)
+        p = VarBase(value, name=name, stop_gradient=False, persistable=True)
+        p.optimize_attr = {
+            "learning_rate": attr.learning_rate if attr else 1.0
+        }
+        p.regularizer = attr.regularizer if attr else None
+        self._parameters[name] = p
+        return p
+
+    def add_parameter(self, name: str, param: VarBase) -> VarBase:
+        self._parameters[name] = param
+        return param
+
+    def add_sublayer(self, name: str, layer: "Layer") -> "Layer":
+        self._sub_layers[name] = layer
+        return layer
+
+    def parameters(self, include_sublayers: bool = True) -> List[VarBase]:
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def sublayers(self, include_sublayers: bool = True) -> List["Layer"]:
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def named_parameters(self) -> Iterator[Tuple[str, VarBase]]:
+        for n, p in self._parameters.items():
+            yield n, p
+        for l in self._sub_layers.values():
+            yield from l.named_parameters()
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # --- state dict (reference: dygraph/checkpoint.py) ---
+
+    def state_dict(self) -> Dict[str, np.ndarray]:
+        return {n: p.numpy() for n, p in self.named_parameters()}
+
+    def set_dict(self, state: Dict[str, np.ndarray], strict: bool = True):
+        own = dict(self.named_parameters())
+        missing = [n for n in own if n not in state]
+        if strict and missing:
+            raise KeyError(
+                f"set_dict: {len(missing)} parameters missing from the "
+                f"state dict (e.g. {missing[:5]})"
+            )
+        for n, p in own.items():
+            if n in state:
+                p._value = jax.numpy.asarray(state[n]).astype(p.dtype)
+
+    load_dict = set_dict
+
+    # --- attribute sugar: assignment registers params/sublayers ---
+
+    def __setattr__(self, name, value):
+        if isinstance(value, VarBase) and getattr(value, "persistable", False):
+            params = self.__dict__.get("_parameters")
+            if params is not None:
+                params[value.name] = value
+        elif isinstance(value, Layer):
+            subs = self.__dict__.get("_sub_layers")
+            if subs is not None:
+                subs[name] = value
+        object.__setattr__(self, name, value)
+
+    # --- forward ---
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    # helper for subclasses
+    def _trace(self, op_type, ins, attrs=None):
+        return get_tracer().trace_op(op_type, ins, attrs)

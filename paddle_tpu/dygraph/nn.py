@@ -1,0 +1,575 @@
+"""Eager layer classes (reference: python/paddle/fluid/dygraph/nn.py:35-2334).
+
+Each class owns its parameters as eager VarBases and routes forward through
+the shared op registry via the tracer, so static and eager modes exercise
+the same kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.dygraph.layers import Layer
+from paddle_tpu.dygraph.tracer import VarBase, get_tracer
+from paddle_tpu.initializer import ConstantInitializer, NormalInitializer
+
+
+def _first(outs, *slots):
+    for s in slots:
+        if s in outs and outs[s]:
+            return outs[s][0]
+    raise KeyError(f"none of {slots} in op outputs")
+
+
+class Conv2D(Layer):
+    """reference: dygraph/nn.py Conv2D (operators/conv_op.cc)."""
+
+    def __init__(
+        self,
+        name_scope,
+        num_filters,
+        filter_size,
+        stride=1,
+        padding=0,
+        dilation=1,
+        groups=1,
+        param_attr=None,
+        bias_attr=None,
+        use_cudnn=True,
+        act=None,
+        dtype="float32",
+    ):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = self._pair(filter_size)
+        self._stride = self._pair(stride)
+        self._padding = self._pair(padding)
+        self._dilation = self._pair(dilation)
+        self._groups = groups
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._filter: Optional[VarBase] = None
+        self._bias: Optional[VarBase] = None
+
+    @staticmethod
+    def _pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+    def _build_once(self, x):
+        cin = x.shape[1]
+        fshape = [
+            self._num_filters,
+            cin // self._groups,
+            self._filter_size[0],
+            self._filter_size[1],
+        ]
+        self._filter = self.create_parameter(
+            self._param_attr, fshape, self._dtype
+        )
+        self._bias = self.create_parameter(
+            self._bias_attr, [self._num_filters], self._dtype, is_bias=True
+        )
+
+    def forward(self, x: VarBase) -> VarBase:
+        if self._filter is None:
+            self._build_once(x)
+        outs = self._trace(
+            "conv2d",
+            {"Input": [x], "Filter": [self._filter]},
+            {
+                "strides": list(self._stride),
+                "paddings": list(self._padding),
+                "dilations": list(self._dilation),
+                "groups": self._groups,
+            },
+        )
+        y = _first(outs, "Output")
+        if self._bias is not None:
+            y = _first(
+                self._trace(
+                    "elementwise_add",
+                    {"X": [y], "Y": [self._bias]},
+                    {"axis": 1},
+                ),
+                "Out",
+            )
+        if self._act:
+            y = _first(self._trace(self._act, {"X": [y]}, {}), "Out")
+        return y
+
+
+class Conv2DTranspose(Conv2D):
+    """reference: dygraph/nn.py Conv2DTranspose."""
+
+    def _build_once(self, x):
+        cin = x.shape[1]
+        fshape = [
+            cin,
+            self._num_filters // self._groups,
+            self._filter_size[0],
+            self._filter_size[1],
+        ]
+        self._filter = self.create_parameter(
+            self._param_attr, fshape, self._dtype
+        )
+        self._bias = self.create_parameter(
+            self._bias_attr, [self._num_filters], self._dtype, is_bias=True
+        )
+
+    def forward(self, x: VarBase) -> VarBase:
+        if self._filter is None:
+            self._build_once(x)
+        outs = self._trace(
+            "conv2d_transpose",
+            {"Input": [x], "Filter": [self._filter]},
+            {
+                "strides": list(self._stride),
+                "paddings": list(self._padding),
+                "dilations": list(self._dilation),
+                "groups": self._groups,
+            },
+        )
+        y = _first(outs, "Output")
+        if self._bias is not None:
+            y = _first(
+                self._trace(
+                    "elementwise_add",
+                    {"X": [y], "Y": [self._bias]},
+                    {"axis": 1},
+                ),
+                "Out",
+            )
+        if self._act:
+            y = _first(self._trace(self._act, {"X": [y]}, {}), "Out")
+        return y
+
+
+class Pool2D(Layer):
+    """reference: dygraph/nn.py Pool2D (operators/pool_op.cc)."""
+
+    def __init__(
+        self,
+        name_scope,
+        pool_size=-1,
+        pool_type="max",
+        pool_stride=1,
+        pool_padding=0,
+        global_pooling=False,
+        ceil_mode=False,
+        exclusive=True,
+        dtype="float32",
+    ):
+        super().__init__(name_scope, dtype)
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": list(Conv2D._pair(pool_size)),
+            "strides": list(Conv2D._pair(pool_stride)),
+            "paddings": list(Conv2D._pair(pool_padding)),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        }
+
+    def forward(self, x: VarBase) -> VarBase:
+        return _first(self._trace("pool2d", {"X": [x]}, dict(self._attrs)), "Out")
+
+
+class FC(Layer):
+    """Fully connected (reference: dygraph/nn.py FC; mul_op.cc)."""
+
+    def __init__(
+        self,
+        name_scope,
+        size,
+        num_flatten_dims=1,
+        param_attr=None,
+        bias_attr=None,
+        act=None,
+        dtype="float32",
+    ):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._num_flatten_dims = num_flatten_dims
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self._w: Optional[VarBase] = None
+        self._b: Optional[VarBase] = None
+
+    def forward(self, x: VarBase) -> VarBase:
+        if self._w is None:
+            in_dim = 1
+            for d in x.shape[self._num_flatten_dims :]:
+                in_dim *= d
+            self._w = self.create_parameter(
+                self._param_attr, [in_dim, self._size], self._dtype
+            )
+            self._b = self.create_parameter(
+                self._bias_attr, [self._size], self._dtype, is_bias=True
+            )
+        y = _first(
+            self._trace(
+                "mul",
+                {"X": [x], "Y": [self._w]},
+                {"x_num_col_dims": self._num_flatten_dims, "y_num_col_dims": 1},
+            ),
+            "Out",
+        )
+        if self._b is not None:
+            y = _first(
+                self._trace(
+                    "elementwise_add",
+                    {"X": [y], "Y": [self._b]},
+                    {"axis": self._num_flatten_dims},
+                ),
+                "Out",
+            )
+        if self._act:
+            y = _first(self._trace(self._act, {"X": [y]}, {}), "Out")
+        return y
+
+
+class Linear(Layer):
+    """Later-API linear layer with explicit dims:
+    ``Linear(input_dim, output_dim, ...)`` (vs FC's lazy input-dim)."""
+
+    def __init__(
+        self,
+        input_dim,
+        output_dim,
+        param_attr=None,
+        bias_attr=None,
+        act=None,
+        dtype="float32",
+    ):
+        super().__init__("linear", dtype)
+        self._act = act
+        self.weight = self.create_parameter(
+            param_attr, [int(input_dim), int(output_dim)], dtype
+        )
+        self.bias = self.create_parameter(
+            bias_attr, [int(output_dim)], dtype, is_bias=True
+        )
+
+    def forward(self, x: VarBase) -> VarBase:
+        y = _first(
+            self._trace(
+                "mul",
+                {"X": [x], "Y": [self.weight]},
+                {"x_num_col_dims": max(x.ndim - 1, 1), "y_num_col_dims": 1},
+            ),
+            "Out",
+        )
+        if self.bias is not None:
+            y = _first(
+                self._trace(
+                    "elementwise_add",
+                    {"X": [y], "Y": [self.bias]},
+                    {"axis": -1},
+                ),
+                "Out",
+            )
+        if self._act:
+            y = _first(self._trace(self._act, {"X": [y]}, {}), "Out")
+        return y
+
+
+class BatchNorm(Layer):
+    """reference: dygraph/nn.py BatchNorm (operators/batch_norm_op.cc).
+    Running mean/variance live as no-grad VarBases updated in place."""
+
+    def __init__(
+        self,
+        name_scope,
+        num_channels,
+        act=None,
+        momentum=0.9,
+        epsilon=1e-5,
+        param_attr=None,
+        bias_attr=None,
+        data_layout="NCHW",
+        dtype="float32",
+    ):
+        super().__init__(name_scope, dtype)
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._layout = data_layout
+        self._act = act
+        self.scale = self.create_parameter(
+            param_attr,
+            [num_channels],
+            dtype,
+            default_initializer=ConstantInitializer(1.0),
+            suffix="scale",
+        )
+        self.bias = self.create_parameter(
+            bias_attr, [num_channels], dtype, is_bias=True, suffix="offset"
+        )
+        import jax.numpy as jnp
+
+        self._mean = VarBase(
+            jnp.zeros((num_channels,), dtype), stop_gradient=True
+        )
+        self._variance = VarBase(
+            jnp.ones((num_channels,), dtype), stop_gradient=True
+        )
+
+    def forward(self, x: VarBase) -> VarBase:
+        outs = self._trace(
+            "batch_norm",
+            {
+                "X": [x],
+                "Scale": [self.scale],
+                "Bias": [self.bias],
+                "Mean": [self._mean],
+                "Variance": [self._variance],
+            },
+            {
+                "epsilon": self._epsilon,
+                "momentum": self._momentum,
+                "is_test": not self.training,
+                "data_layout": self._layout,
+            },
+        )
+        # in-place running-stat update (reference batch_norm MeanOut<-Mean)
+        if self.training:
+            self._mean._value = outs["MeanOut"][0]._value
+            self._variance._value = outs["VarianceOut"][0]._value
+        y = _first(outs, "Y")
+        if self._act:
+            y = _first(self._trace(self._act, {"X": [y]}, {}), "Out")
+        return y
+
+
+class Embedding(Layer):
+    """reference: dygraph/nn.py Embedding (operators/lookup_table_op.cc)."""
+
+    def __init__(
+        self,
+        name_scope,
+        size,
+        is_sparse=False,
+        padding_idx=None,
+        param_attr=None,
+        dtype="float32",
+    ):
+        super().__init__(name_scope, dtype)
+        self._padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            param_attr,
+            list(size),
+            dtype,
+            default_initializer=NormalInitializer(0.0, 0.02),
+        )
+
+    def forward(self, ids: VarBase) -> VarBase:
+        attrs = {}
+        if self._padding_idx is not None:
+            attrs["padding_idx"] = self._padding_idx
+        return _first(
+            self._trace(
+                "lookup_table", {"W": [self.weight], "Ids": [ids]}, attrs
+            ),
+            "Out",
+        )
+
+
+class LayerNorm(Layer):
+    """reference: dygraph/nn.py LayerNorm (operators/layer_norm_op.cc)."""
+
+    def __init__(
+        self,
+        name_scope,
+        normalized_shape,
+        scale=True,
+        shift=True,
+        begin_norm_axis=1,
+        epsilon=1e-5,
+        param_attr=None,
+        bias_attr=None,
+        act=None,
+        dtype="float32",
+    ):
+        super().__init__(name_scope, dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._epsilon = epsilon
+        self._begin_norm_axis = begin_norm_axis
+        self._act = act
+        n = 1
+        for d in normalized_shape:
+            n *= d
+        self.scale = (
+            self.create_parameter(
+                param_attr,
+                [n],
+                dtype,
+                default_initializer=ConstantInitializer(1.0),
+                suffix="scale",
+            )
+            if scale
+            else None
+        )
+        self.bias = (
+            self.create_parameter(
+                bias_attr, [n], dtype, is_bias=True, suffix="offset"
+            )
+            if shift
+            else None
+        )
+
+    def forward(self, x: VarBase) -> VarBase:
+        y = _first(
+            self._trace(
+                "layer_norm",
+                {
+                    "X": [x],
+                    "Scale": [self.scale] if self.scale is not None else [],
+                    "Bias": [self.bias] if self.bias is not None else [],
+                },
+                {
+                    "epsilon": self._epsilon,
+                    "begin_norm_axis": self._begin_norm_axis,
+                },
+            ),
+            "Y",
+        )
+        if self._act:
+            y = _first(self._trace(self._act, {"X": [y]}, {}), "Out")
+        return y
+
+
+class GroupNorm(Layer):
+    """reference: dygraph/nn.py GroupNorm (operators/group_norm_op.cc)."""
+
+    def __init__(
+        self,
+        name_scope,
+        channels,
+        groups,
+        epsilon=1e-5,
+        param_attr=None,
+        bias_attr=None,
+        act=None,
+        dtype="float32",
+    ):
+        super().__init__(name_scope, dtype)
+        self._groups = groups
+        self._epsilon = epsilon
+        self._act = act
+        self.scale = self.create_parameter(
+            param_attr,
+            [channels],
+            dtype,
+            default_initializer=ConstantInitializer(1.0),
+            suffix="scale",
+        )
+        self.bias = self.create_parameter(
+            bias_attr, [channels], dtype, is_bias=True, suffix="offset"
+        )
+
+    def forward(self, x: VarBase) -> VarBase:
+        y = _first(
+            self._trace(
+                "group_norm",
+                {"X": [x], "Scale": [self.scale], "Bias": [self.bias]},
+                {"groups": self._groups, "epsilon": self._epsilon},
+            ),
+            "Y",
+        )
+        if self._act:
+            y = _first(self._trace(self._act, {"X": [y]}, {}), "Out")
+        return y
+
+
+class PRelu(Layer):
+    """reference: dygraph/nn.py PRelu (operators/prelu_op.cc)."""
+
+    def __init__(
+        self, name_scope, mode="all", channel=None, input_shape=None,
+        param_attr=None, dtype="float32",
+    ):
+        super().__init__(name_scope, dtype)
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [channel]
+        elif mode == "element":
+            shape = list(input_shape[1:])
+        else:
+            raise ValueError(f"unknown prelu mode {mode!r}")
+        self.alpha = self.create_parameter(
+            param_attr,
+            shape,
+            dtype,
+            default_initializer=ConstantInitializer(0.25),
+            suffix="alpha",
+        )
+
+    def forward(self, x: VarBase) -> VarBase:
+        return _first(
+            self._trace(
+                "prelu", {"X": [x], "Alpha": [self.alpha]}, {"mode": self._mode}
+            ),
+            "Out",
+        )
+
+
+class GRUUnit(Layer):
+    """One-step GRU cell (reference: dygraph/nn.py GRUUnit)."""
+
+    def __init__(
+        self,
+        name_scope,
+        size,
+        param_attr=None,
+        bias_attr=None,
+        activation="tanh",
+        gate_activation="sigmoid",
+        dtype="float32",
+    ):
+        super().__init__(name_scope, dtype)
+        if size % 3 != 0:
+            raise ValueError("GRUUnit size must be 3 * hidden")
+        h = size // 3
+        self._attrs = {
+            "activation": activation,
+            "gate_activation": gate_activation,
+        }
+        self.weight = self.create_parameter(param_attr, [h, 3 * h], dtype)
+        self.bias = self.create_parameter(
+            bias_attr, [3 * h], dtype, is_bias=True
+        )
+
+    def forward(self, x: VarBase, hidden: VarBase):
+        outs = self._trace(
+            "gru_unit",
+            {
+                "Input": [x],
+                "HiddenPrev": [hidden],
+                "Weight": [self.weight],
+                "Bias": [self.bias] if self.bias is not None else [],
+            },
+            dict(self._attrs),
+        )
+        return outs["Hidden"][0], outs["ResetHiddenPrev"][0], outs["Gate"][0]
+
+
+class Dropout(Layer):
+    """Eager dropout honoring train/eval mode."""
+
+    def __init__(self, name_scope, p=0.5, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._p = p
+
+    def forward(self, x: VarBase) -> VarBase:
+        return _first(
+            self._trace(
+                "dropout",
+                {"X": [x]},
+                {"dropout_prob": self._p, "is_test": not self.training},
+            ),
+            "Out",
+        )

@@ -352,8 +352,32 @@ def layer_norm(
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
                act=None, data_layout="NCHW", name=None):
-    # Composed from reshape + layer_norm semantics via primitive ops.
-    raise NotImplementedError("group_norm lands with the vision op pack")
+    """Group normalization (reference: layers/nn.py group_norm,
+    operators/group_norm_op.cc)."""
+    if data_layout != "NCHW":
+        raise ValueError("group_norm supports NCHW layout")
+    helper = LayerHelper("group_norm", name=name, act=act)
+    c = input.shape[1]
+    scale = helper.create_parameter(
+        ParamAttr._to_attr(param_attr), shape=[c], dtype=input.dtype,
+        default_initializer=ConstantInitializer(1.0),
+    )
+    bias = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr), shape=[c], dtype=input.dtype,
+        is_bias=True,
+    )
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    mean = helper.create_variable_for_type_inference(
+        dtype=input.dtype, stop_gradient=True)
+    var = helper.create_variable_for_type_inference(
+        dtype=input.dtype, stop_gradient=True)
+    helper.append_op(
+        "group_norm",
+        inputs={"X": input, "Scale": scale, "Bias": bias},
+        outputs={"Y": out, "Mean": mean, "Variance": var},
+        attrs={"groups": groups, "epsilon": epsilon},
+    )
+    return helper.append_activation(out)
 
 
 def dropout(
@@ -434,11 +458,14 @@ def prelu(x, mode="all", param_attr=None, name=None):
         ParamAttr._to_attr(param_attr), shape=shape, dtype=x.dtype,
         default_initializer=ConstantInitializer(0.25),
     )
-    # prelu(x) = max(0, x) + alpha * min(0, x) composed from primitives
-    pos = relu(x)
-    neg = elementwise_sub(x, pos)
-    scaled = elementwise_mul(neg, alpha, axis=1 if mode == "channel" else -1)
-    return elementwise_add(pos, scaled)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        "prelu",
+        inputs={"X": x, "Alpha": alpha},
+        outputs={"Out": out},
+        attrs={"mode": mode},
+    )
+    return out
 
 
 def maxout(x, groups, name=None):

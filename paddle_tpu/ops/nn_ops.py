@@ -370,3 +370,49 @@ def _label_smooth(ins, attrs):
     if dist and dist[0] is not None:
         return {"Out": [(1 - eps) * x + eps * dist[0]]}
     return {"Out": [(1 - eps) * x + eps / k]}
+
+
+@register_op("prelu", diff_inputs=("X", "Alpha"))
+def _prelu(ins, attrs):
+    """out = x > 0 ? x : alpha * x; alpha shared per-op, per-channel, or
+    per-element by `mode` (reference: operators/prelu_op.cc)."""
+    x, alpha = _x(ins), _x(ins, "Alpha")
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        shape = [1] * jnp.ndim(x)
+        shape[1] = -1
+        alpha = jnp.reshape(alpha, shape)
+    elif mode == "element":
+        alpha = jnp.reshape(alpha, (1,) + tuple(jnp.shape(x)[1:]))
+    else:
+        alpha = jnp.reshape(alpha, ())
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+@register_op("group_norm", diff_inputs=("X", "Scale", "Bias"))
+def _group_norm(ins, attrs):
+    """Normalize over channel groups of an NCHW tensor
+    (reference: operators/group_norm_op.cc)."""
+    x = _x(ins)
+    scale, bias = _x(ins, "Scale"), _x(ins, "Bias")
+    g = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = jnp.shape(x)[0], jnp.shape(x)[1]
+    spatial = tuple(jnp.shape(x)[2:])
+    stat_dtype = jnp.promote_types(x.dtype, jnp.float32)
+    xg = jnp.reshape(x.astype(stat_dtype), (n, g, c // g) + spatial)
+    axes = tuple(range(2, jnp.ndim(xg)))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = (xg - mean) * jax.lax.rsqrt(var + eps)
+    y = jnp.reshape(y, jnp.shape(x))
+    pshape = [1, c] + [1] * len(spatial)
+    if scale is not None:
+        y = y * jnp.reshape(scale, pshape).astype(stat_dtype)
+    if bias is not None:
+        y = y + jnp.reshape(bias, pshape).astype(stat_dtype)
+    return {
+        "Y": [y.astype(x.dtype)],
+        "Mean": [jax.lax.stop_gradient(jnp.reshape(mean, (n, g)))],
+        "Variance": [jax.lax.stop_gradient(jnp.reshape(var, (n, g)))],
+    }

@@ -166,3 +166,31 @@ def _gru(ins, attrs):
     xs = xt if mt is None else (xt, mt)
     h_last, hs = lax.scan(step, h0, xs, reverse=reverse)
     return {"Hidden": [jnp.swapaxes(hs, 0, 1)], "LastH": [h_last]}
+
+
+@register_op("gru_unit", diff_inputs=("Input", "HiddenPrev", "Weight", "Bias"))
+def _gru_unit(ins, attrs):
+    """One GRU step (reference: operators/gru_unit_op.cc).
+
+    inputs: Input [B,3H] (x projection, gate order u,r,c),
+    HiddenPrev [B,H], Weight [H,3H], Bias [3H] optional.
+    outputs: Hidden [B,H], Gate [B,3H], ResetHiddenPrev [B,H].
+    """
+    x = ins["Input"][0]
+    h_prev = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cand_act = _act(attrs.get("activation", "tanh"))
+    hsz = jnp.shape(h_prev)[-1]
+    if bias is not None:
+        x = x + bias
+    xu, xr, xc = x[:, :hsz], x[:, hsz : 2 * hsz], x[:, 2 * hsz :]
+    wu, wr, wc = w[:, :hsz], w[:, hsz : 2 * hsz], w[:, 2 * hsz :]
+    u = gate_act(xu + h_prev @ wu)
+    r = gate_act(xr + h_prev @ wr)
+    rh = r * h_prev
+    c = cand_act(xc + rh @ wc)
+    h = u * h_prev + (1.0 - u) * c
+    gate = jnp.concatenate([u, r, c], axis=-1)
+    return {"Hidden": [h], "Gate": [gate], "ResetHiddenPrev": [rh]}

@@ -119,11 +119,129 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        from paddle_tpu.dygraph import base as dy_base
+
+        if dy_base._in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set
         )
         opt_ops = self.apply_gradients(params_grads)
         return opt_ops, params_grads
+
+    # --- dygraph (eager) path ---
+    #
+    # The eager twin of apply_gradients (reference: optimizer.py dygraph
+    # branch of backward()/minimize()): the per-class _append_optimize_op
+    # logic is reused verbatim by tracing it once into a throwaway Program
+    # whose vars mirror the eager parameters by name, then jitting one
+    # function (params, grads, state) -> (params', state') over the traced
+    # op list. Accumulator state lives on the optimizer as jax arrays.
+
+    def _dygraph_build(self, params):
+        import jax
+        import numpy as np
+
+        from paddle_tpu.core.interp import exec_ops
+        from paddle_tpu.framework import Program
+
+        if isinstance(self._lr_input, Variable):
+            raise TypeError(
+                "dygraph minimize needs a float learning rate (static LR "
+                "schedule variables belong to a Program)"
+            )
+        # Carry accumulator state (moments, beta pows, ...) across rebuilds
+        # triggered by a changed trainable-parameter set: state is keyed by
+        # (accumulator kind, param name), which survives var renaming.
+        old_acc = {}
+        if getattr(self, "_dy_state", None) is not None:
+            for kind, d in self._accumulators.items():
+                for pname, var in d.items():
+                    if var.name in self._dy_state:
+                        old_acc[(kind, pname)] = self._dy_state[var.name]
+
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            block = prog.global_block()
+            fake_pgs = []
+            for p in params:
+                dtype = str(np.dtype(p.dtype))
+                fp = block.create_parameter(
+                    p.name,
+                    list(p.shape),
+                    dtype,
+                    optimize_attr=getattr(
+                        p, "optimize_attr", {"learning_rate": 1.0}
+                    ),
+                    regularizer=getattr(p, "regularizer", None),
+                )
+                g = block.create_var(
+                    name=fp.grad_name, shape=list(p.shape), dtype=dtype
+                )
+                fake_pgs.append((fp, g))
+            opt_ops = self.apply_gradients(fake_pgs)
+        del opt_ops  # the full main-block op list includes clip/reg ops
+        update_ops = list(prog.global_block().ops)
+        state0 = exec_ops(
+            list(startup.global_block().ops), {}, key=None, amp=False
+        )
+        for kind, d in self._accumulators.items():
+            for pname, var in d.items():
+                if (kind, pname) in old_acc and var.name in state0:
+                    state0[var.name] = old_acc[(kind, pname)]
+        state_names = sorted(state0)
+        param_names = [p.name for p in params]
+
+        def step(state, param_vals, grad_vals):
+            env = dict(state)
+            for n, v, g in zip(param_names, param_vals, grad_vals):
+                env[n] = v
+                env[n + "@GRAD"] = g
+            exec_ops(update_ops, env, key=None, amp=False)
+            return (
+                [env[n] for n in param_names],
+                {n: env[n] for n in state_names},
+            )
+
+        self._dy_state = {n: state0[n] for n in state_names}
+        self._dy_step = jax.jit(step)
+        self._dy_param_names = param_names
+
+    def _dygraph_minimize(self, loss, parameter_list):
+        import jax.numpy as jnp
+
+        if not parameter_list:
+            raise ValueError(
+                "minimize() in dygraph mode requires parameter_list "
+                "(e.g. model.parameters())"
+            )
+        params = [p for p in parameter_list if not p.stop_gradient]
+        if all(p._grad is None for p in params):
+            # The reference's eager contract: the user calls
+            # loss.backward() first, then minimize() applies the collected
+            # gradients. Auto-running backward here would silently reuse
+            # stale gradients on later iterations.
+            raise RuntimeError(
+                "minimize() in dygraph mode found no gradients; call "
+                "loss.backward() before minimize(), and "
+                "clear_gradients() after each step"
+            )
+        if getattr(self, "_dy_step", None) is None or [
+            p.name for p in params
+        ] != self._dy_param_names:
+            self._dygraph_build(params)
+        grads = [
+            p._grad
+            if p._grad is not None
+            else jnp.zeros(p.shape, p.dtype)
+            for p in params
+        ]
+        new_vals, self._dy_state = self._dy_step(
+            self._dy_state, [p._value for p in params], grads
+        )
+        for p, v in zip(params, new_vals):
+            p._value = v
+        return [], [(p, p._grad) for p in params]
 
 
 class SGDOptimizer(Optimizer):

@@ -270,3 +270,65 @@ def test_dropout_eval_and_train():
     # kept entries upscaled
     kept = tr[tr != 0]
     np.testing.assert_allclose(kept, 1.0 / 0.7, rtol=1e-5)
+
+
+def test_prelu_op_modes():
+    x = RS(0).randn(2, 3, 4, 4)
+    for mode, alpha in (
+        ("all", RS(1).randn(1)),
+        ("channel", RS(2).randn(3)),
+        ("element", RS(3).randn(3, 4, 4)),
+    ):
+        h = OpHarness("prelu", {"X": x, "Alpha": alpha}, attrs={"mode": mode})
+        if mode == "channel":
+            a = alpha.reshape(1, 3, 1, 1)
+        elif mode == "element":
+            a = alpha.reshape(1, 3, 4, 4)
+        else:
+            a = alpha.reshape(())
+        h.check_output({"Out": np.where(x > 0, x, a * x)})
+        h.check_grad(["x_0", "alpha_0"])
+
+
+def test_group_norm_op():
+    x = RS(0).randn(2, 6, 4, 4)
+    scale, bias = RS(1).randn(6), RS(2).randn(6)
+    h = OpHarness(
+        "group_norm",
+        {"X": x, "Scale": scale, "Bias": bias},
+        attrs={"groups": 3, "epsilon": 1e-5},
+        out_slots=("Y",),
+    )
+    xg = x.reshape(2, 3, 2, 4, 4)
+    mean = xg.mean(axis=(2, 3, 4), keepdims=True)
+    var = xg.var(axis=(2, 3, 4), keepdims=True)
+    y = ((xg - mean) / np.sqrt(var + 1e-5)).reshape(2, 6, 4, 4)
+    y = y * scale.reshape(1, 6, 1, 1) + bias.reshape(1, 6, 1, 1)
+    h.check_output({"Y": y})
+    h.check_grad(["x_0", "scale_0", "bias_0"])
+
+
+def test_gru_unit_op():
+    b, hsz = 2, 4
+    x = RS(0).randn(b, 3 * hsz)
+    hp = RS(1).randn(b, hsz)
+    w = RS(2).randn(hsz, 3 * hsz) * 0.5
+    bias = RS(3).randn(3 * hsz) * 0.1
+    h = OpHarness(
+        "gru_unit",
+        {"Input": x, "HiddenPrev": hp, "Weight": w, "Bias": bias},
+        out_slots=("Hidden",),
+    )
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    xb = x + bias
+    xu, xr, xc = xb[:, :hsz], xb[:, hsz : 2 * hsz], xb[:, 2 * hsz :]
+    wu, wr, wc = w[:, :hsz], w[:, hsz : 2 * hsz], w[:, 2 * hsz :]
+    u = sig(xu + hp @ wu)
+    r = sig(xr + hp @ wr)
+    c = np.tanh(xc + (r * hp) @ wc)
+    expected = u * hp + (1 - u) * c
+    h.check_output({"Hidden": expected})
+    h.check_grad(["input_0", "hiddenprev_0", "weight_0", "bias_0"])
