@@ -27,7 +27,12 @@ from paddle_tpu.compiler import (  # noqa: F401
     CompiledProgram,
     ExecutionStrategy,
 )
-from paddle_tpu.executor import Executor, Scope, global_scope  # noqa: F401
+from paddle_tpu.executor import (  # noqa: F401
+    Executor,
+    Scope,
+    global_scope,
+    scope_guard,
+)
 from paddle_tpu.framework import (  # noqa: F401
     CPUPlace,
     CUDAPlace,

@@ -27,7 +27,11 @@ enabled = _in_dygraph_mode
 @contextlib.contextmanager
 def guard(place=None):
     """Enter dygraph mode. ``place`` is accepted for API parity; device
-    placement is JAX's default-device policy (TPU when present)."""
+    placement is JAX's default-device policy (TPU when present).
+
+    For inference loops use :func:`no_grad` inside the guard — otherwise
+    every op touching a trainable parameter is taped until ``backward()``
+    consumes it. The tape is released when the guard exits."""
     global _in_dygraph
     old = _in_dygraph
     _in_dygraph = True
@@ -35,6 +39,8 @@ def guard(place=None):
         yield
     finally:
         _in_dygraph = old
+        if not old:
+            get_tracer().reset()
 
 
 def to_variable(value, name=None, block=None) -> VarBase:

@@ -308,11 +308,25 @@ class BatchNorm(Layer):
         )
         import jax.numpy as jnp
 
-        self._mean = VarBase(
-            jnp.zeros((num_channels,), dtype), stop_gradient=True
+        # Running stats are persistable (round-trip through state_dict)
+        # but stop_gradient, so optimizers skip them.
+        self._mean = self.add_parameter(
+            f"{self._full_name}.mean",
+            VarBase(
+                jnp.zeros((num_channels,), dtype),
+                name=f"{self._full_name}.mean",
+                stop_gradient=True,
+                persistable=True,
+            ),
         )
-        self._variance = VarBase(
-            jnp.ones((num_channels,), dtype), stop_gradient=True
+        self._variance = self.add_parameter(
+            f"{self._full_name}.variance",
+            VarBase(
+                jnp.ones((num_channels,), dtype),
+                name=f"{self._full_name}.variance",
+                stop_gradient=True,
+                persistable=True,
+            ),
         )
 
     def forward(self, x: VarBase) -> VarBase:
@@ -320,8 +334,8 @@ class BatchNorm(Layer):
             "batch_norm",
             {
                 "X": [x],
-                "Scale": [self.scale],
-                "Bias": [self.bias],
+                "Scale": [self.scale] if self.scale is not None else [],
+                "Bias": [self.bias] if self.bias is not None else [],
                 "Mean": [self._mean],
                 "Variance": [self._variance],
             },
@@ -364,7 +378,7 @@ class Embedding(Layer):
         )
 
     def forward(self, ids: VarBase) -> VarBase:
-        attrs = {}
+        attrs = {"squeeze_last": False}
         if self._padding_idx is not None:
             attrs["padding_idx"] = self._padding_idx
         return _first(

@@ -148,6 +148,7 @@ class Tracer:
 
     def __init__(self, seed: int = 0):
         self._tape: List[_TapeEntry] = []
+        self._tape_warned = False
         self._grad_enabled = True
         self._key = jax.random.PRNGKey(seed)
         self._op_count = 0
@@ -231,6 +232,17 @@ class Tracer:
             self._tape.append(
                 _TapeEntry(op_def, arr_ins, attrs, norm_ins, out_vars, rng)
             )
+            # Forward-only loops (inference without no_grad) would retain
+            # every activation forever; warn once so the leak is visible.
+            if len(self._tape) > 100_000 and not self._tape_warned:
+                self._tape_warned = True
+                import warnings
+
+                warnings.warn(
+                    "dygraph tape exceeds 100k entries without backward(); "
+                    "wrap inference in dygraph.no_grad() or call "
+                    "get_tracer().reset() to release held activations"
+                )
         return out_vars
 
     # --- backward ---

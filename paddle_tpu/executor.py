@@ -10,6 +10,8 @@ prepared-context cache (reference: executor.py:704).
 
 from __future__ import annotations
 
+import contextlib
+
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -64,6 +66,17 @@ _global_scope = Scope()
 
 def global_scope() -> Scope:
     return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    """Swap the global scope (reference: fluid.executor.scope_guard)."""
+    global _global_scope
+    old, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = old
 
 
 class Executor:

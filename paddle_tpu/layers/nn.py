@@ -108,7 +108,11 @@ def embedding(
         ParamAttr._to_attr(param_attr), shape=list(size), dtype=dtype
     )
     out = helper.create_variable_for_type_inference(dtype=dtype)
-    attrs = {} if padding_idx is None else {"padding_idx": int(padding_idx)}
+    # Padded [b, t] ids convention: never squeeze, even when t == 1 (the
+    # op's squeeze heuristic exists for the reference's [N, 1] column ids).
+    attrs = {"squeeze_last": False}
+    if padding_idx is not None:
+        attrs["padding_idx"] = int(padding_idx)
     helper.append_op(
         "lookup_table",
         inputs={"W": w, "Ids": input},

@@ -1,0 +1,74 @@
+"""Decoding ops: beam search.
+
+TPU-native redesign of the reference's beam-search operators
+(reference: operators/beam_search_op.cc, beam_search_decode_op.cc,
+python/paddle/fluid/layers/control_flow.py beam search wrappers). The
+reference keeps per-hypothesis LoD structures and backtracks parent
+pointers at the end (beam_search_decode); here beams are a dense
+``[batch, beam]`` axis with static shapes, and each step gathers the full
+id history by parent beam — O(T) extra copies per step, but branch-free,
+fully batched, and compiled into the XLA While body (no host round trips).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+
+
+@register_op("beam_search_step", no_grad=True)
+def _beam_search_step(ins, attrs):
+    """One beam-search expansion step.
+
+    inputs:
+      Ids      [B, K, T] int   — id history (position >= StepIdx is garbage)
+      Scores   [B, K] f32      — cumulative log-probs per live hypothesis
+      LogProbs [B, K, V] f32   — log p(next token) at the current position
+      Finished [B, K] bool     — hypotheses that already emitted end_id
+      StepIdx  [] int          — time position the chosen token is written to
+    attrs: end_id (int).
+    outputs: Ids / Scores / Finished (updated), Parent [B, K] int64.
+
+    Finished hypotheses only extend with end_id at zero cost, so they
+    compete in the top-k on their frozen score (reference
+    beam_search_op.cc keeps finished hypotheses in the candidate set the
+    same way).
+    """
+    ids = ins["Ids"][0]
+    scores = ins["Scores"][0]
+    logp = ins["LogProbs"][0]
+    finished = ins["Finished"][0].astype(bool)
+    t = jnp.reshape(ins["StepIdx"][0], ()).astype(jnp.int32)
+    end_id = int(attrs.get("end_id", 1))
+
+    b, k, v = jnp.shape(logp)
+    neg_inf = jnp.asarray(jnp.finfo(logp.dtype).min, logp.dtype)
+
+    # Finished rows: only end_id is a legal continuation, with logp 0.
+    eos_row = jnp.full((v,), neg_inf, logp.dtype).at[end_id].set(0.0)
+    logp = jnp.where(finished[:, :, None], eos_row[None, None, :], logp)
+
+    total = scores[:, :, None] + logp                      # [B, K, V]
+    flat = jnp.reshape(total, (b, k * v))
+    top_scores, top_idx = lax.top_k(flat, k)               # [B, K]
+    parent = (top_idx // v).astype(jnp.int32)
+    token = (top_idx % v).astype(ids.dtype)
+
+    new_ids = jnp.take_along_axis(ids, parent[:, :, None], axis=1)
+    new_ids = lax.dynamic_update_slice(
+        new_ids,
+        token[:, :, None],
+        (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32), t),
+    )
+    new_finished = jnp.take_along_axis(finished, parent, axis=1) | (
+        token == end_id
+    )
+    return {
+        "Ids": [new_ids],
+        "Scores": [top_scores],
+        "Finished": [new_finished],
+        "Parent": [parent.astype(jnp.int64)],
+    }

@@ -165,9 +165,11 @@ def _batch_norm(ins, attrs):
         saved_var = use_var
 
     inv = jax.lax.rsqrt(use_var + eps)
-    y = (x - use_mean.reshape(shape)) * inv.reshape(shape) * scale.reshape(
-        shape
-    ) + bias.reshape(shape)
+    y = (x - use_mean.reshape(shape)) * inv.reshape(shape)
+    if scale is not None:
+        y = y * scale.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
     return {
         "Y": [y],
         "MeanOut": [jax.lax.stop_gradient(new_mean)],

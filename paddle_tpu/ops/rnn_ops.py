@@ -38,6 +38,12 @@ def _act(name):
 import jax  # noqa: E402  (used by _act closures)
 
 
+def _opt(ins, slot):
+    """Optional slot -> array or None (empty list and [None] both mean absent)."""
+    vals = ins.get(slot)
+    return vals[0] if vals else None
+
+
 def _length_mask(ins, b, t, dtype):
     length = ins.get("Length")
     if not length or length[0] is None:
@@ -61,11 +67,11 @@ def _lstm(ins, attrs):
     """
     x = ins["Input"][0]
     w = ins["Weight"][0]
-    bias = ins.get("Bias", [None])[0]
+    bias = _opt(ins, "Bias")
     b_, t_, four_h = x.shape
     h_dim = four_h // 4
-    h0 = ins.get("H0", [None])[0]
-    c0 = ins.get("C0", [None])[0]
+    h0 = _opt(ins, "H0")
+    c0 = _opt(ins, "C0")
     if h0 is None:
         h0 = jnp.zeros((b_, h_dim), x.dtype)
     if c0 is None:
@@ -128,10 +134,10 @@ def _gru(ins, attrs):
     """
     x = ins["Input"][0]
     w = ins["Weight"][0]
-    bias = ins.get("Bias", [None])[0]
+    bias = _opt(ins, "Bias")
     b_, t_, three_h = x.shape
     h_dim = three_h // 3
-    h0 = ins.get("H0", [None])[0]
+    h0 = _opt(ins, "H0")
     if h0 is None:
         h0 = jnp.zeros((b_, h_dim), x.dtype)
     gate_act = _act(attrs.get("gate_activation", "sigmoid"))
@@ -179,7 +185,7 @@ def _gru_unit(ins, attrs):
     x = ins["Input"][0]
     h_prev = ins["HiddenPrev"][0]
     w = ins["Weight"][0]
-    bias = ins.get("Bias", [None])[0]
+    bias = _opt(ins, "Bias")
     gate_act = _act(attrs.get("gate_activation", "sigmoid"))
     cand_act = _act(attrs.get("activation", "tanh"))
     hsz = jnp.shape(h_prev)[-1]

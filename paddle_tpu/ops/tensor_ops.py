@@ -219,7 +219,11 @@ def _one_hot(ins, attrs):
                  "(lookup_table_op.cc)")
 def _lookup_table(ins, attrs):
     w, ids = _x(ins, "W"), _x(ins, "Ids")
-    squeeze_last = jnp.ndim(ids) > 1 and jnp.shape(ids)[-1] == 1
+    # [N, 1] column-ids convention: squeeze unless the layer says the ids
+    # are already a padded [b, t] batch (a [b, 1] batch is ambiguous).
+    squeeze_last = attrs.get(
+        "squeeze_last", jnp.ndim(ids) > 1 and jnp.shape(ids)[-1] == 1
+    )
     if squeeze_last:
         ids = jnp.squeeze(ids, axis=-1)
     # Reference semantics: kNoPadding when absent; negative = vocab + idx

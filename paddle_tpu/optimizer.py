@@ -208,15 +208,21 @@ class Optimizer:
         self._dy_param_names = param_names
 
     def _dygraph_minimize(self, loss, parameter_list):
-        import jax.numpy as jnp
-
         if not parameter_list:
             raise ValueError(
                 "minimize() in dygraph mode requires parameter_list "
                 "(e.g. model.parameters())"
             )
-        params = [p for p in parameter_list if not p.stop_gradient]
-        if all(p._grad is None for p in params):
+        # Only parameters reached by this step's backward get updated —
+        # matching the static path, where apply_gradients sees exactly the
+        # params on the loss's op path (untouched params must not drift
+        # from regularization/moment updates).
+        params = [
+            p
+            for p in parameter_list
+            if not p.stop_gradient and p._grad is not None
+        ]
+        if not params:
             # The reference's eager contract: the user calls
             # loss.backward() first, then minimize() applies the collected
             # gradients. Auto-running backward here would silently reuse
@@ -230,12 +236,7 @@ class Optimizer:
             p.name for p in params
         ] != self._dy_param_names:
             self._dygraph_build(params)
-        grads = [
-            p._grad
-            if p._grad is not None
-            else jnp.zeros(p.shape, p.dtype)
-            for p in params
-        ]
+        grads = [p._grad for p in params]
         new_vals, self._dy_state = self._dy_step(
             self._dy_state, [p._value for p in params], grads
         )

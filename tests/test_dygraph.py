@@ -319,3 +319,36 @@ def test_static_group_norm_layer():
     out = exe.run(main, feed={"x": xv}, fetch_list=[y, loss])
     assert out[0].shape == (2, 6, 4, 4)
     assert np.isfinite(out[1]).all()
+
+
+def test_batchnorm_stats_roundtrip_and_no_affine(tmp_path):
+    """Running mean/variance must survive state_dict round-trips
+    (code-review finding, round 2), and param_attr=False must work."""
+    with dygraph.guard():
+        bn = nn.BatchNorm("bn", num_channels=3)
+        x = to_variable(np.random.randn(4, 3, 5, 5).astype(np.float32) + 2.0)
+        bn(x)
+        sd = bn.state_dict()
+        stats = [k for k in sd if k.endswith(".mean") or k.endswith(".variance")]
+        assert len(stats) == 2
+        assert not np.allclose(sd[[k for k in stats if k.endswith(".mean")][0]], 0)
+
+        bn2 = nn.BatchNorm("bn2", num_channels=3)
+        remap = dict(zip([n for n, _ in bn2.named_parameters()], sd.values()))
+        bn2.set_dict(remap)
+        bn.eval(); bn2.eval()
+        np.testing.assert_allclose(bn2(x).numpy(), bn(x).numpy(), rtol=1e-6)
+
+        bn3 = nn.BatchNorm("bn3", num_channels=3, param_attr=False,
+                           bias_attr=False)
+        y = bn3(x)
+        assert y.shape == (4, 3, 5, 5)
+
+
+def test_gru_unit_without_bias():
+    with dygraph.guard():
+        gru = nn.GRUUnit("gru", size=3 * 8, bias_attr=False)
+        xproj = to_variable(np.random.randn(2, 24).astype(np.float32))
+        h0 = to_variable(np.zeros((2, 8), np.float32))
+        h, _, _ = gru(xproj, h0)
+        assert h.shape == (2, 8)
