@@ -84,6 +84,24 @@ def set_amp_active(flag: bool):
     return _AMP_ACTIVE.set(bool(flag))
 
 
+# SPMD context for ops that need explicit shard_map collectives (ring
+# attention over a context axis, psum-sharded embedding tables) rather than
+# relying on GSPMD propagation. Set by the Executor while tracing a program
+# compiled with a DistributedStrategy that declares those axes; kernels read
+# it at trace time. (mesh, context_axis, table_axis, data_axis) or None.
+_SPMD_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "paddle_tpu_spmd_ctx", default=None
+)
+
+
+def spmd_ctx():
+    return _SPMD_CTX.get()
+
+
+def set_spmd_ctx(ctx):
+    return _SPMD_CTX.set(ctx)
+
+
 def _is_f32(v):
     return v is not None and hasattr(v, "dtype") and v.dtype == jnp.float32
 

@@ -161,6 +161,18 @@ class Executor:
         if compiled is not None:
             state, feed_vals = compiled.shard_inputs(state, feed_vals)
 
+        # Ops needing explicit collectives (ring attention, sharded tables)
+        # read the SPMD context at trace time, which happens inside the
+        # first jitted call.
+        from paddle_tpu.core import interp as _interp
+
+        spmd_ctx = None
+        if compiled is not None and compiled._strategy is not None:
+            st = compiled._strategy
+            if st.context_axis or st.table_axis:
+                spmd_ctx = (st.mesh, st.context_axis, st.table_axis,
+                            st.data_axis)
+        tok = _interp.set_spmd_ctx(spmd_ctx)
         with _profiler.record_event("executor.run_step"):
             try:
                 fetches, new_state = fn(state, feed_vals, rng)
@@ -175,6 +187,8 @@ class Executor:
                     if isinstance(v, jax.Array) and v.is_deleted():
                         scope.drop(n)
                 raise
+            finally:
+                _interp._SPMD_CTX.reset(tok)
         for n, v in new_state.items():
             scope.set(n, v)
 

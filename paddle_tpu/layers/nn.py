@@ -113,6 +113,10 @@ def embedding(
     attrs = {"squeeze_last": False}
     if padding_idx is not None:
         attrs["padding_idx"] = int(padding_idx)
+    if is_distributed:
+        # Marks the table for row-sharded lookup (psum over the strategy's
+        # table axis) when run under CompiledProgram.with_strategy.
+        attrs["is_distributed"] = True
     helper.append_op(
         "lookup_table",
         inputs={"W": w, "Ids": input},

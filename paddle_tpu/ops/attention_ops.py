@@ -75,6 +75,28 @@ def _sdpa_config(ins, attrs, rng):
     return scale, drop, seed, use_pallas
 
 
+def _ring_config(q, k, drop):
+    """(mesh, context_axis, data_axis) when sequence-parallel ring
+    attention applies, else None. Requires a strategy-declared context
+    axis, BOTH sequence lengths divisible by the axis size (cross
+    attention has tq != tk), and no attention dropout (the ring kernel
+    computes the softmax online across rotating K/V blocks, so a
+    per-element dropout mask over the full row never exists on one
+    chip). Non-qualifying attention falls back to the flash/dense path."""
+    from paddle_tpu.core.interp import spmd_ctx
+
+    ctx = spmd_ctx()
+    if ctx is None:
+        return None
+    mesh, ctx_axis, _table_axis, data_axis = ctx
+    if ctx_axis is None or drop > 0.0:
+        return None
+    n = mesh.shape[ctx_axis]
+    if n <= 1 or jnp.shape(q)[2] % n != 0 or jnp.shape(k)[2] % n != 0:
+        return None
+    return mesh, ctx_axis, data_axis
+
+
 @register_op("scaled_dot_product_attention", diff_inputs=("Q", "K", "V"),
              needs_rng=True)
 def _sdpa(ins, attrs, rng=None):
@@ -93,7 +115,15 @@ def _sdpa(ins, attrs, rng=None):
     scale, drop, seed, use_pallas = _sdpa_config(ins, attrs, rng)
     from paddle_tpu.parallel import flash_attention as fa
 
-    if use_pallas:
+    ring = _ring_config(q, k, drop)
+    if ring is not None:
+        mesh, ctx_axis, data_axis = ring
+        from paddle_tpu.parallel import ring_attention as ra
+
+        out = ra.ring_attention(q, k, v, mesh, seq_axis=ctx_axis,
+                                scale=scale, bias=bias, data_axis=data_axis)
+        lse = jnp.zeros(jnp.shape(q)[:3] + (1,), jnp.float32)
+    elif use_pallas:
         out, lse = fa.flash_attention_fwd(q, k, v, bias=bias, seed=seed,
                                           scale=scale, p_drop=drop)
     else:
@@ -116,7 +146,20 @@ def _sdpa_grad(ins, attrs, rng=None):
     scale, drop, seed, use_pallas = _sdpa_config(ins, attrs, rng)
     from paddle_tpu.parallel import flash_attention as fa
 
-    if use_pallas:
+    ring = _ring_config(q, k, drop)
+    if ring is not None:
+        mesh, ctx_axis, data_axis = ring
+        from paddle_tpu.parallel import ring_attention as ra
+
+        def f(q, k, v):
+            return ra.ring_attention(
+                q, k, v, mesh, seq_axis=ctx_axis, scale=scale, bias=bias,
+                data_axis=data_axis,
+            )
+
+        _, vjp = jax.vjp(f, q, k, v)
+        dq, dk, dv = vjp(g.astype(q.dtype))
+    elif use_pallas:
         # gates internally between the blocked Pallas kernels and a vjp of
         # the same dense composition the forward used — one source of truth
         # for masks and fallback conditions

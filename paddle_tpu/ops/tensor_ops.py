@@ -229,7 +229,30 @@ def _lookup_table(ins, attrs):
     # Reference semantics: kNoPadding when absent; negative = vocab + idx
     # (lookup_table_op.cc). The layer omits the attr when padding is off.
     padding_idx = attrs.get("padding_idx", None)
-    out = jnp.take(w, ids, axis=0)
+    out = None
+    if attrs.get("is_distributed", False):
+        # Row-sharded table (replaces the reference's pserver-distributed
+        # lookup table + RPC prefetch, parameter_prefetch.cc): each shard
+        # gathers its local rows, psum over ICI combines. Only active when
+        # the program runs under a strategy declaring a table axis.
+        from paddle_tpu.core.interp import spmd_ctx
+
+        ctx = spmd_ctx()
+        if ctx is not None:
+            mesh, _ctx_axis, table_axis, data_axis = ctx
+            if table_axis is not None and (
+                jnp.shape(w)[0] % mesh.shape[table_axis] == 0
+            ):
+                from paddle_tpu.parallel.embedding import (
+                    sharded_embedding_lookup,
+                )
+
+                out = sharded_embedding_lookup(
+                    w, ids, mesh, shard_axis=table_axis,
+                    data_axis=data_axis,
+                )
+    if out is None:
+        out = jnp.take(w, ids, axis=0)
     if padding_idx is not None:
         if padding_idx < 0:
             padding_idx = jnp.shape(w)[0] + padding_idx

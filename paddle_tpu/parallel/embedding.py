@@ -40,13 +40,22 @@ def sharded_embedding_lookup(
     ids,
     mesh: Mesh,
     shard_axis: str = "model",
+    data_axis: Optional[str] = None,
 ):
-    """table: [V, D] sharded over rows on ``shard_axis``; ids: any int shape
-    (replicated). Returns gathered embeddings [..., D] (replicated)."""
+    """table: [V, D] sharded over rows on ``shard_axis``; ids: any int
+    shape, batch-sharded over ``data_axis`` on dim 0 when given (keeps the
+    gathered [b, ..., D] output batch-sharded instead of replicating it).
+    Negative ids wrap (reference lookup_table_op.cc: negative = vocab+id),
+    matching the dense path."""
+    ids = jnp.where(ids < 0, ids + table.shape[0], ids)
+    d = data_axis if (data_axis in mesh.axis_names and
+                      jnp.shape(ids)[0] % mesh.shape[data_axis] == 0) else None
+    ids_spec = P(d, *([None] * (jnp.ndim(ids) - 1)))
+    out_spec = P(d, *([None] * jnp.ndim(ids)))
     fn = jax.shard_map(
         functools.partial(_sharded_lookup_local, axis_name=shard_axis),
         mesh=mesh,
-        in_specs=(P(shard_axis, None), P()),
-        out_specs=P(),
+        in_specs=(P(shard_axis, None), ids_spec),
+        out_specs=out_spec,
     )
     return fn(table, ids)

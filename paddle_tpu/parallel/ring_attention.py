@@ -21,11 +21,15 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+def _ring_attention_local(q, k, v, bias, *, axis_name: str, causal: bool,
                           scale: float):
     """Per-shard body (runs inside shard_map).
 
-    q: [b, h, tq_loc, dh]; k, v: [b, h, tk_loc, dh] (this rank's block).
+    q: [b, h, tq_loc, dh]; k, v: [b, h, tk_loc, dh] (this rank's block);
+    bias: optional additive [b, 1|h, tq_loc, tk_GLOBAL] — the query dim is
+    sharded with q, the key dim stays global and is sliced per ring step
+    (bias tensors already encode causal+padding masks, so a bias-carrying
+    caller does not also pass ``causal``).
     """
     n = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -43,6 +47,11 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
         src = (rank - i) % n
         s = jnp.einsum("bhqd,bhkd->bhqk", q_f32, k_blk.astype(jnp.float32))
         s = s * scale
+        if bias is not None:
+            blk = jax.lax.dynamic_slice_in_dim(
+                bias, src * tk, tk, axis=3
+            )
+            s = s + blk.astype(jnp.float32)
         if causal:
             q_pos = rank * tq + jnp.arange(tq)
             k_pos = src * tk + jnp.arange(tk)
@@ -63,9 +72,13 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
     m0 = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, tq), jnp.float32)
     o0 = jnp.zeros((b, h, tq, q.shape[3]), jnp.float32)
-    # initial carries are rank-invariant; mark them varying over the ring
-    # axis so the scan carry type matches the per-rank outputs
-    m0, l0, o0 = jax.lax.pcast((m0, l0, o0), (axis_name,), to="varying")
+    # initial carries are rank-invariant; mark them varying over every
+    # sharded mesh axis (ring axis + any batch/data axis the inputs carry)
+    # so the scan carry type matches the per-rank outputs
+    vary = tuple(
+        a for a in (jax.typeof(q).vma | {axis_name}) if a is not None
+    )
+    m0, l0, o0 = jax.lax.pcast((m0, l0, o0), vary, to="varying")
     (k_f, v_f, m, l, o), _ = jax.lax.scan(
         step, (k, v, m0, l0, o0), jnp.arange(n)
     )
@@ -80,24 +93,48 @@ def ring_attention(
     seq_axis: str = "sp",
     causal: bool = False,
     scale: Optional[float] = None,
+    bias=None,
+    data_axis: Optional[str] = None,
 ):
     """Sequence-parallel attention: q, k, v are [b, h, t, dh] GLOBAL arrays
-    (sharded or shardable over ``seq_axis`` on dim 2)."""
+    (sharded or shardable over ``seq_axis`` on dim 2). ``bias`` is an
+    optional additive [b, 1|h, tq, tk] mask (sharded over tq, global over
+    tk). ``data_axis`` additionally shards the batch dim."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    spec = P(None, None, seq_axis, None)
+    d = data_axis
+    spec = P(d, None, seq_axis, None)
+    in_specs = [spec, spec, spec]
+    if bias is not None:
+        # broadcast dims (size 1) cannot be sharded: a [b,1,1,tk] pad-only
+        # bias keeps its q dim replicated, and the k dim is always global
+        # (sliced per ring step inside the body).
+        in_specs.append(P(
+            d if bias.shape[0] > 1 else None,
+            None,
+            seq_axis if bias.shape[2] > 1 else None,
+            None,
+        ))
+    else:
+        in_specs.append(P())
+
+    has_bias = bias is not None
+    if not has_bias:
+        bias = jnp.zeros((), q.dtype)  # placeholder, dropped in `local`
+
+    def local(q, k, v, b):
+        return _ring_attention_local(
+            q, k, v, b if has_bias else None,
+            axis_name=seq_axis, causal=causal, scale=scale,
+        )
+
     fn = jax.shard_map(
-        functools.partial(
-            _ring_attention_local,
-            axis_name=seq_axis,
-            causal=causal,
-            scale=scale,
-        ),
+        local,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=tuple(in_specs),
         out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(q, k, v, bias)
 
 
 def reference_attention(q, k, v, causal: bool = False, scale=None):

@@ -29,6 +29,12 @@ class ShardingRule:
         return self._re.search(name) is not None
 
 
+_SCALAR_STATE_RULES = [
+    ShardingRule(r"_(beta1_pow|beta2_pow)_\d+$", P()),
+    ShardingRule(r"^learning_rate", P()),
+]
+
+
 class DistributedStrategy:
     """mesh + data axis + parameter sharding rules.
 
@@ -43,13 +49,35 @@ class DistributedStrategy:
         data_axis: Optional[str] = "data",
         rules: Sequence[ShardingRule] = (),
         strict: bool = False,
+        context_axis: Optional[str] = None,
+        table_axis: Optional[str] = None,
     ):
         self.mesh = mesh
         self.data_axis = data_axis if data_axis in mesh.axis_names else None
         self.rules = list(rules)
         self.strict = strict
+        # Sequence/context parallelism: attention ops route through the
+        # ring-attention shard_map over this axis (SURVEY.md section 5
+        # "long-context"). None = no sequence sharding.
+        self.context_axis = (
+            context_axis if context_axis in mesh.axis_names else None
+        )
+        # Sharded embedding tables: lookup_table(is_distributed=True) rows
+        # are sharded over this axis (replaces the reference's distributed
+        # lookup table / pserver prefetch).
+        self.table_axis = (
+            table_axis if table_axis in mesh.axis_names else None
+        )
 
     def spec_for(self, name: str) -> P:
+        # Scalar optimizer state (Adam beta pows, LR) can never shard;
+        # resolved ahead of user rules so a parameter-suffix rule like
+        # ``foo\.w(_|$)`` doesn't claim ``foo.w_beta1_pow_0`` (rank 1) and
+        # fail jit's rank check. Checked before user rules but outside
+        # ``self.rules`` so strict-with-no-user-rules stays a no-op.
+        for r in _SCALAR_STATE_RULES:
+            if r.matches(name):
+                return r.spec
         for r in self.rules:
             if r.matches(name):
                 return r.spec
@@ -84,10 +112,8 @@ def transformer_rules(model_axis: str = "model") -> List[ShardingRule]:
     """
     m = model_axis
     return [
-        # Scalars, norms, and embeddings stay replicated. Listed first so the
-        # broader suffix rules below never claim a beta-pow scalar.
-        ShardingRule(r"_(beta1_pow|beta2_pow)_\d+$", P()),
-        ShardingRule(r"^learning_rate", P()),  # incl. scheduler step state
+        # Norms and embeddings stay replicated (scalar optimizer state is
+        # handled by the strategy's built-in _SCALAR_STATE_RULES).
         ShardingRule(r"_ln\.(scale|bias)(_|$)", P()),
         ShardingRule(r"^(src|trg)_(emb|pos)\.w(_|$)", P()),
         # Megatron TP: column-parallel shards the output dim, row-parallel

@@ -1,0 +1,216 @@
+"""SPMD wiring of ring attention + sharded embedding through the Program IR.
+
+VERDICT round-1 item 4: these capabilities must run via
+``exe.run(CompiledProgram)`` — not as standalone JAX calls. Both are
+checked for loss/gradient parity against the plain single-device path on
+the virtual 8-device mesh (reference parity harness analog:
+tests/unittests/parallel_executor_test_base.py).
+"""
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.models import deepfm
+from paddle_tpu.parallel.strategy import DistributedStrategy, ShardingRule
+
+
+def _snapshot(prog):
+    return {
+        p.name: np.array(fluid.global_scope().find_var(p.name))
+        for p in prog.all_parameters()
+    }
+
+
+def _restore(snap):
+    for k, v in snap.items():
+        fluid.global_scope().set(k, v)
+
+
+def _mesh(shape, names):
+    import jax
+
+    devs = np.asarray(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+# --- sharded embedding through the IR (DeepFM) ---
+
+
+def test_deepfm_trains_single_device():
+    cfg = deepfm.DeepFMConfig(num_fields=8, vocab_size=128, embed_dim=4)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        model = deepfm.build(cfg)
+        fluid.optimizer.Adam(5e-3).minimize(model["loss"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for step in range(60):
+        fd = deepfm.make_batch(cfg, 64, seed=step % 8)
+        losses.append(float(exe.run(main, feed=fd,
+                                    fetch_list=[model["loss"]])[0]))
+    assert losses[-1] < 0.55, f"DeepFM did not learn: {losses[-1]}"
+    assert losses[-1] < losses[0]
+
+
+def test_deepfm_sharded_table_loss_parity():
+    """Row-sharded embedding tables (table_axis) vs single device: same
+    per-step losses while training through the Executor."""
+    cfg = deepfm.DeepFMConfig(num_fields=8, vocab_size=128, embed_dim=4)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        model = deepfm.build(cfg)
+        fluid.optimizer.SGD(0.1).minimize(model["loss"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    snap = _snapshot(main)
+    batches = [deepfm.make_batch(cfg, 32, seed=s) for s in range(6)]
+
+    single = [
+        float(exe.run(main, feed=fd, fetch_list=[model["loss"]])[0])
+        for fd in batches
+    ]
+
+    _restore(snap)
+    mesh = _mesh((2, 4), ("data", "model"))
+    strategy = DistributedStrategy(
+        mesh,
+        data_axis="data",
+        table_axis="model",
+        rules=[
+            ShardingRule(r"^deepfm_(first|factor)\.w(_|$)", P("model", None)),
+        ],
+    )
+    compiled = fluid.CompiledProgram(main).with_strategy(strategy)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    sharded = [
+        float(exe2.run(compiled, feed=fd, fetch_list=[model["loss"]])[0])
+        for fd in batches
+    ]
+    np.testing.assert_allclose(single, sharded, rtol=1e-4, atol=1e-4)
+    assert sharded[-1] < sharded[0]
+
+
+# --- ring attention through the IR (sequence parallelism) ---
+
+
+def _attn_program(t=16, d=8, h=2, causal=True):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[t, d], dtype="float32")
+        pad = layers.data("pad", shape=[t], dtype="float32")
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper("attn")
+        bias = helper.create_variable_for_type_inference("float32", True)
+        helper.append_op("attn_bias", inputs={"PadMask": pad},
+                         outputs={"Out": bias}, attrs={"causal": causal})
+        q = layers.fc(x, d, num_flatten_dims=2,
+                      param_attr=fluid.ParamAttr(name="q.w"), bias_attr=False)
+        k = layers.fc(x, d, num_flatten_dims=2,
+                      param_attr=fluid.ParamAttr(name="k.w"), bias_attr=False)
+        v = layers.fc(x, d, num_flatten_dims=2,
+                      param_attr=fluid.ParamAttr(name="v.w"), bias_attr=False)
+
+        def heads(z):
+            z = layers.reshape(z, [0, 0, h, d // h])
+            return layers.transpose(z, [0, 2, 1, 3])
+
+        ctx = helper.create_variable_for_type_inference("float32")
+        lse = helper.create_variable_for_type_inference("float32")
+        lse.stop_gradient = True
+        helper.append_op(
+            "scaled_dot_product_attention",
+            inputs={"Q": heads(q), "K": heads(k), "V": heads(v),
+                    "Bias": bias},
+            outputs={"Out": ctx, "Lse": lse},
+            attrs={"is_test": True, "dropout_prob": 0.0},
+        )
+        loss = layers.mean(ctx)
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_through_executor_parity(causal):
+    """sdpa routes to ring attention under a context-axis strategy; the
+    full train step (fwd + grads + SGD) must match single-device."""
+    t = 16
+    main, startup, loss = _attn_program(t=t, causal=causal)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    snap = _snapshot(main)
+    rng = np.random.RandomState(0)
+    batches = []
+    for s in range(4):
+        x = rng.randn(4, t, 8).astype(np.float32)
+        pad = (np.arange(t)[None, :] < rng.randint(t // 2, t + 1, 4)[:, None]
+               ).astype(np.float32)
+        batches.append({"x": x, "pad": pad})
+
+    single = [float(exe.run(main, feed=fd, fetch_list=[loss])[0])
+              for fd in batches]
+
+    _restore(snap)
+    mesh = _mesh((2, 4), ("data", "sp"))
+    strategy = DistributedStrategy(mesh, data_axis="data", context_axis="sp")
+    compiled = fluid.CompiledProgram(main).with_strategy(strategy)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    ring = [float(exe2.run(compiled, feed=fd, fetch_list=[loss])[0])
+            for fd in batches]
+
+    np.testing.assert_allclose(single, ring, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_transformer_model_parity():
+    """Flagship transformer forward under dp x sp sequence parallelism."""
+    from paddle_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        src_vocab_size=50, trg_vocab_size=50, max_length=32, d_model=16,
+        d_inner=32, n_head=2, n_layer=1, dropout=0.0, label_smooth_eps=0.0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        model = transformer.build(cfg, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    batch = transformer.make_batch(cfg, 4, 16, 16, seed=0)
+    # full-length rows: ring attention shards the sequence axis evenly
+    batch["src_pad_mask"][:] = 1.0
+    batch["trg_pad_mask"][:] = 1.0
+
+    (ref,) = exe.run(main, feed=batch, fetch_list=[model["loss"]])
+
+    mesh = _mesh((2, 4), ("data", "sp"))
+    strategy = DistributedStrategy(mesh, data_axis="data", context_axis="sp")
+    compiled = fluid.CompiledProgram(main).with_strategy(strategy)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe2.run(compiled, feed=batch, fetch_list=[model["loss"]])
+    np.testing.assert_allclose(float(ref), float(got), rtol=2e-4)
+
+
+def test_sharded_table_adam_scalar_accumulators():
+    """Adam's scalar beta-pow accumulators must not inherit a rank-2 table
+    rule via the name-suffix match (verify-drive finding, round 2)."""
+    cfg = deepfm.DeepFMConfig(num_fields=4, vocab_size=64, embed_dim=4)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        model = deepfm.build(cfg)
+        fluid.optimizer.Adam(5e-3).minimize(model["loss"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    mesh = _mesh((2, 4), ("data", "model"))
+    strategy = DistributedStrategy(
+        mesh, data_axis="data", table_axis="model",
+        rules=[ShardingRule(r"^deepfm_(first|factor)\.w(_|$)",
+                            P("model", None))])
+    compiled = fluid.CompiledProgram(main).with_strategy(strategy)
+    losses = [
+        float(exe.run(compiled, feed=deepfm.make_batch(cfg, 32, seed=s),
+                      fetch_list=[model["loss"]])[0])
+        for s in range(30)
+    ]
+    assert losses[-1] < losses[0]
