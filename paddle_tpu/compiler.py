@@ -102,16 +102,40 @@ class CompiledProgram:
             return None, None
         repl = NamedSharding(self._mesh, P())
         if self._strategy is None:
-            batch = NamedSharding(self._mesh, P("data"))
-            return (repl, batch, repl), (repl, repl)
+            return (repl, self._batch_sharding(), repl), (repl, repl)
         st = self._strategy
         state_in = {n: st.sharding_for(n) for n in lowered.state_in_names}
         state_out = {n: st.sharding_for(n) for n in lowered.state_out_names}
-        batch = st.batch_sharding()
-        in_shardings = (state_in, batch, st.replicated())
+        in_shardings = (state_in, self._batch_sharding(), st.replicated())
         out_shardings = (st.replicated(), state_out)
         return in_shardings, out_shardings
 
     def shard_inputs(self, state, feeds):
-        """Pre-place inputs; jit's in_shardings handles the real placement."""
-        return state, feeds
+        """Pre-place inputs; jit's in_shardings handles the real placement.
+
+        Multi-host (fleet) jobs: each process holds only ITS batch shard,
+        so feeds are assembled into global arrays with
+        ``jax.make_array_from_process_local_data`` (the analog of the
+        reference's per-trainer feed in NCCL2 mode, test_dist_base.py:459
+        — every process feeds its slice of the global batch). State stays
+        host-numpy: parameters are replicated and identical across
+        processes (same seeded startup program)."""
+        if jax.process_count() <= 1 or self._mesh is None:
+            return state, feeds
+        batch_sh = self._batch_sharding()
+        new_feeds = {
+            # already-global jax.Arrays pass through (the executor keeps
+            # them untouched too); host numpy is this process's shard
+            k: v
+            if isinstance(v, jax.Array)
+            else jax.make_array_from_process_local_data(batch_sh, v)
+            for k, v in feeds.items()
+        }
+        return state, new_feeds
+
+    def _batch_sharding(self):
+        """Feed sharding — single source for shardings() and
+        shard_inputs(), which must agree on placement."""
+        if self._strategy is not None:
+            return self._strategy.batch_sharding()
+        return NamedSharding(self._mesh, P("data"))

@@ -1,0 +1,13 @@
+"""Fleet distributed-training façade
+(reference: python/paddle/fluid/incubate/fleet/)."""
+
+from paddle_tpu.incubate.fleet.fleet_base import (  # noqa: F401
+    DistributedOptimizer,
+    Fleet,
+    fleet,
+)
+from paddle_tpu.incubate.fleet.role_maker import (  # noqa: F401
+    EnvRoleMaker,
+    RoleMakerBase,
+    UserDefinedRoleMaker,
+)

@@ -1,0 +1,201 @@
+"""Fleet: the multi-host training façade
+(reference: incubate/fleet/base/fleet_base.py — fleet.init / init_worker /
+distributed_optimizer / stop_worker; collective mode
+incubate/fleet/collective/__init__.py).
+
+TPU-native bootstrap (replaces the reference's gen_nccl_id RPC exchange,
+operators/distributed_ops/gen_nccl_id_op.cc:62):
+
+1. rank 0 starts the native CoordServer (csrc/coord.cc: KV + barrier +
+   heartbeat over one TCP port);
+2. every worker connects a CoordClient, rendezvouses (KV put/get of the
+   PJRT coordinator address), and barriers;
+3. ``jax.distributed.initialize`` brings up the PJRT distributed runtime —
+   after which ``jax.devices()`` is the GLOBAL device list and GSPMD
+   programs span all hosts (collectives ride ICI/DCN, not RPC).
+
+After init, ``fleet.mesh(...)`` builds global meshes and
+``fleet.compiled_program(main)`` wraps a Program for global
+data parallelism; per-step liveness goes through heartbeat/dead_workers
+(SURVEY.md section 5 failure detection).
+"""
+
+from __future__ import annotations
+
+import atexit
+from typing import Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.incubate.fleet.role_maker import (
+    EnvRoleMaker,
+    RoleMakerBase,
+)
+
+
+class Fleet:
+    def __init__(self):
+        self._role: Optional[RoleMakerBase] = None
+        self._server = None
+        self._client = None
+        self._initialized = False
+
+    # --- lifecycle (reference: fleet_base.py init/init_worker) ---
+
+    def init(self, role_maker: Optional[RoleMakerBase] = None,
+             connect_timeout_ms: int = 60_000):
+        """Rendezvous + distributed runtime init. Single-worker jobs
+        (worker_num == 1) need no endpoints and become a no-op."""
+        if self._initialized:
+            return self
+        self._role = role_maker or EnvRoleMaker()
+        n = self._role.worker_num()
+        if n > 1:
+            from paddle_tpu import native
+
+            endpoint = self._role.coord_endpoint()
+            if not endpoint:
+                raise ValueError(
+                    "multi-worker fleet.init needs a coordination endpoint "
+                    "(PT_COORD_ENDPOINT=host:port)"
+                )
+            host, port = endpoint.rsplit(":", 1)
+            port = int(port)
+            if self._role.is_first_worker():
+                self._server = native.CoordServer(port)
+            # workers retry-connect until rank 0's server is up
+            self._client = _connect_retry(host, port, connect_timeout_ms)
+
+            jax_ep = self._role.jax_coord_endpoint() or f"{host}:{port + 1}"
+            if self._role.is_first_worker():
+                self._client.put("fleet/jax_coordinator", jax_ep.encode())
+            else:
+                jax_ep = self._client.get(
+                    "fleet/jax_coordinator", timeout_ms=connect_timeout_ms
+                ).decode()
+            self._client.barrier("fleet/rendezvous", n)
+
+            import jax
+
+            jax.distributed.initialize(
+                jax_ep,
+                num_processes=n,
+                process_id=self._role.worker_index(),
+            )
+            atexit.register(self.stop_worker)
+        self._initialized = True
+        return self
+
+    def stop_worker(self):
+        if self._client is not None:
+            try:
+                self._client.close()
+            except OSError:
+                pass
+            self._client = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+        self._initialized = False
+
+    # --- identity ---
+
+    def worker_index(self) -> int:
+        return self._role.worker_index() if self._role else 0
+
+    def worker_num(self) -> int:
+        return self._role.worker_num() if self._role else 1
+
+    def is_first_worker(self) -> bool:
+        return self.worker_index() == 0
+
+    # --- collective helpers ---
+
+    def barrier(self, name: str = "fleet/barrier"):
+        if self._client is not None:
+            self._client.barrier(name, self.worker_num())
+
+    def put(self, key: str, value: bytes):
+        if self._client is None:
+            raise RuntimeError("fleet.init with multiple workers first")
+        self._client.put(key, value)
+
+    def get(self, key: str, timeout_ms: int = -1) -> bytes:
+        if self._client is None:
+            raise RuntimeError("fleet.init with multiple workers first")
+        return self._client.get(key, timeout_ms=timeout_ms)
+
+    # --- failure detection (SURVEY.md section 5) ---
+
+    def heartbeat(self):
+        if self._client is not None:
+            self._client.heartbeat(f"worker-{self.worker_index()}")
+
+    def dead_workers(self, max_age_ms: int = 30_000) -> Sequence[str]:
+        if self._client is None:
+            return []
+        return self._client.dead_peers(max_age_ms)
+
+    # --- program compilation over the global mesh ---
+
+    def mesh(self, shape: Optional[Sequence[int]] = None,
+             axis_names: Sequence[str] = ("data",)):
+        """A Mesh over ALL global devices (defaults to 1-D data mesh)."""
+        import jax
+        from jax.sharding import Mesh
+
+        devs = np.asarray(jax.devices())
+        if shape is not None:
+            devs = devs.reshape(tuple(shape))
+        return Mesh(devs, tuple(axis_names))
+
+    def compiled_program(self, main_program, strategy=None):
+        """Program -> CompiledProgram over the global device mesh; pass a
+        DistributedStrategy for tp/sp/table sharding on top of dp."""
+        from paddle_tpu.compiler import CompiledProgram
+
+        if strategy is not None:
+            return CompiledProgram(main_program).with_strategy(strategy)
+        return CompiledProgram(main_program).with_data_parallel()
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return DistributedOptimizer(self, optimizer, strategy)
+
+
+class DistributedOptimizer:
+    """Wraps an Optimizer for fleet jobs (reference: fleet_base.py
+    DistributedOptimizer): minimize() is unchanged graph-side — data
+    parallelism is a sharding of the SAME program, not a graph rewrite —
+    and the fleet remembers the strategy for compiled_program()."""
+
+    def __init__(self, fleet: Fleet, inner, strategy=None):
+        self._fleet = fleet
+        self._inner = inner
+        self.strategy = strategy
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._inner.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _connect_retry(host: str, port: int, timeout_ms: int):
+    import time
+
+    from paddle_tpu import native
+
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    while True:
+        try:
+            return native.CoordClient(host, port)
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.1)
+
+
+fleet = Fleet()

@@ -1,0 +1,78 @@
+"""Role makers: who am I in the distributed job
+(reference: incubate/fleet/base/role_maker.py — MPISymetricRoleMaker /
+UserDefinedRoleMaker / PaddleCloudRoleMaker).
+
+TPU jobs have one role (worker); there is no parameter-server role because
+tables shard over the mesh (SURVEY.md section 2.3). The env-driven maker
+reads:
+
+- ``PT_TRAINER_ID``     — this worker's rank (int)
+- ``PT_TRAINERS``       — world size (int)
+- ``PT_COORD_ENDPOINT`` — ``host:port`` of the rank-0 coordination service
+- ``PT_JAX_COORD_ENDPOINT`` — optional ``host:port`` for the PJRT
+  distributed runtime (defaults to the coord host with port+1)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+
+class RoleMakerBase:
+    def worker_index(self) -> int:
+        raise NotImplementedError
+
+    def worker_num(self) -> int:
+        raise NotImplementedError
+
+    def is_first_worker(self) -> bool:
+        return self.worker_index() == 0
+
+    def coord_endpoint(self) -> Optional[str]:
+        return None
+
+    def jax_coord_endpoint(self) -> Optional[str]:
+        return None
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """Explicit rank/world/endpoints (reference: role_maker.py
+    UserDefinedRoleMaker)."""
+
+    def __init__(
+        self,
+        current_id: int,
+        worker_num: int,
+        coord_endpoint: Optional[str] = None,
+        jax_coord_endpoint: Optional[str] = None,
+    ):
+        self._id = int(current_id)
+        self._n = int(worker_num)
+        self._coord = coord_endpoint
+        self._jax_coord = jax_coord_endpoint
+
+    def worker_index(self) -> int:
+        return self._id
+
+    def worker_num(self) -> int:
+        return self._n
+
+    def coord_endpoint(self):
+        return self._coord
+
+    def jax_coord_endpoint(self):
+        return self._jax_coord
+
+
+class EnvRoleMaker(UserDefinedRoleMaker):
+    """Rank/world/endpoints from PT_* env vars (reference:
+    PaddleCloudRoleMaker reading PADDLE_TRAINER_ID etc.)."""
+
+    def __init__(self):
+        super().__init__(
+            current_id=int(os.environ.get("PT_TRAINER_ID", "0")),
+            worker_num=int(os.environ.get("PT_TRAINERS", "1")),
+            coord_endpoint=os.environ.get("PT_COORD_ENDPOINT"),
+            jax_coord_endpoint=os.environ.get("PT_JAX_COORD_ENDPOINT"),
+        )

@@ -1,0 +1,128 @@
+"""Fleet multi-host bootstrap tests.
+
+The subprocess-on-localhost pattern of the reference
+(tests/unittests/test_dist_base.py:311-684): spawn 2 worker processes that
+rendezvous through the native coordination service (csrc/coord.cc),
+bring up the PJRT distributed runtime on a 2x2-device CPU mesh, train
+data-parallel, and assert per-step loss parity against a single-process
+run of the same deterministic model.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.incubate.fleet import UserDefinedRoleMaker, fleet as _fleet
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _single_process_losses():
+    sys.path.insert(0, HERE)
+    try:
+        import fleet_worker as fw
+    finally:
+        sys.path.pop(0)
+    main, startup, loss = fw.build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out = []
+        for x, y in fw.global_batches():
+            out.append(float(
+                exe.run(main, feed={"img": x, "label": y},
+                        fetch_list=[loss])[0]))
+    return out
+
+
+def test_fleet_two_process_loss_parity():
+    from paddle_tpu import native
+
+    if not native.available():
+        pytest.skip("native library not built")
+    port = _free_port()
+    env_base = {
+        **os.environ,
+        "PT_TRAINERS": "2",
+        "PT_COORD_ENDPOINT": f"127.0.0.1:{port}",
+        "PT_JAX_COORD_ENDPOINT": f"127.0.0.1:{_free_port()}",
+        # workers configure jax themselves; drop any pytest leakage
+        "JAX_PLATFORMS": "",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(HERE), os.environ.get("PYTHONPATH", "")]
+        ),
+    }
+    procs = []
+    for rank in range(2):
+        env = {**env_base, "PT_TRAINER_ID": str(rank)}
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "fleet_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        ))
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"worker failed:\n{out}\n{err}"
+        line = [l for l in out.splitlines() if l.startswith("FLEET_RESULT ")]
+        assert line, f"no result line:\n{out}\n{err}"
+        r = json.loads(line[-1][len("FLEET_RESULT "):])
+        results[r["rank"]] = r["losses"]
+
+    assert set(results) == {0, 1}
+    # both workers fetch the same (global-mean) loss
+    np.testing.assert_allclose(results[0], results[1], rtol=1e-5)
+    # and it matches the single-process run over the full global batch
+    single = _single_process_losses()
+    np.testing.assert_allclose(single, results[0], rtol=1e-4, atol=1e-5)
+    assert results[0][-1] < results[0][0]  # learning
+
+
+def test_fleet_single_worker_noop():
+    f = _fleet.__class__()
+    f.init(UserDefinedRoleMaker(current_id=0, worker_num=1))
+    assert f.worker_num() == 1 and f.is_first_worker()
+    assert f.dead_workers() == []
+    f.barrier()  # no-op without a client
+    f.stop_worker()
+
+
+def test_fleet_kv_and_liveness_single_process():
+    """Coord-backed KV/heartbeat through the fleet façade (one process
+    hosting the server and connecting as its own client)."""
+    from paddle_tpu import native
+
+    if not native.available():
+        pytest.skip("native library not built")
+    port = _free_port()
+    f = _fleet.__class__()
+    role = UserDefinedRoleMaker(
+        current_id=0, worker_num=2,  # pretend, to exercise the server path
+        coord_endpoint=f"127.0.0.1:{port}",
+    )
+    # init would block on the 2-worker barrier + jax.distributed; drive the
+    # pieces directly instead.
+    f._role = role
+    f._server = native.CoordServer(port)
+    f._client = native.CoordClient("127.0.0.1", port)
+    try:
+        f.put("k", b"v")
+        assert f.get("k", timeout_ms=1000) == b"v"
+        f.heartbeat()
+        assert f.dead_workers(max_age_ms=60_000) == []
+    finally:
+        f.stop_worker()
