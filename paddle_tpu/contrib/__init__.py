@@ -1,0 +1,10 @@
+"""High-level training APIs (reference: python/paddle/fluid/contrib/)."""
+
+from paddle_tpu.contrib.trainer import (  # noqa: F401
+    BeginEpochEvent,
+    BeginStepEvent,
+    CheckpointConfig,
+    EndEpochEvent,
+    EndStepEvent,
+    Trainer,
+)

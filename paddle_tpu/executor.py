@@ -142,6 +142,11 @@ class Executor:
                 )
             if use_program_cache:
                 self._cache[key] = entry
+                from paddle_tpu import flags as _flags_mod
+
+                cap = _flags_mod.get_flag("executor_cache_capacity")
+                while cap > 0 and len(self._cache) > cap:
+                    self._cache.pop(next(iter(self._cache)))
         fn, lowered = entry
 
         state = {}
@@ -189,8 +194,19 @@ class Executor:
                 raise
             finally:
                 _interp._SPMD_CTX.reset(tok)
+        from paddle_tpu import flags as _flags
+
+        if _flags.get_flag("benchmark"):
+            # honest per-step timing: wait for device work
+            # (reference: FLAGS_benchmark forced Wait, operator.cc:946)
+            jax.block_until_ready((fetches, new_state))
+        # Commit new state BEFORE any post-step check can raise: the old
+        # buffers were donated to the jitted call and are already deleted,
+        # so raising first would strand the scope on dead arrays.
         for n, v in new_state.items():
             scope.set(n, v)
+        if _flags.get_flag("check_nan_inf"):
+            self._check_nan_inf(fetch_names, fetches, new_state)
 
         if return_numpy:
             fetches = [np.asarray(x) for x in fetches]
@@ -198,6 +214,27 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+    @staticmethod
+    def _check_nan_inf(fetch_names, fetches, new_state):
+        """Per-step NaN/Inf scan of fetches + updated state
+        (reference: FLAGS_check_nan_inf scan, operator.cc:950)."""
+        bad = []
+        for name, v in list(zip(fetch_names, fetches)) + list(
+            new_state.items()
+        ):
+            try:
+                if jnp.issubdtype(jnp.result_type(v), jnp.floating) and not bool(
+                    jnp.isfinite(v).all()
+                ):
+                    bad.append(name)
+            except TypeError:
+                continue
+        if bad:
+            raise FloatingPointError(
+                f"check_nan_inf: non-finite values in {bad} after this "
+                f"step (set flag 'check_nan_inf' to False to disable)"
+            )
 
     # --- internals ---
 

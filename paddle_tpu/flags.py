@@ -1,0 +1,67 @@
+"""Process-wide flag plane (reference: gflags — 41 ``DEFINE_*`` sites in
+core C++ — bootstrapped from whitelisted env vars in
+fluid/__init__.py:106-164 via ``init_gflags``/pybind.cc:954).
+
+Flags are typed, defaulted, and settable three ways: env vars
+``PT_FLAGS_<name>`` at import, ``set_flags({...})`` at runtime, or the
+reference-style ``FLAGS_<name>`` env spelling. Unknown names raise —
+a typo'd flag silently doing nothing is the failure mode gflags avoids.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+# name -> (type, default, doc)
+_DEFS: Dict[str, tuple] = {
+    # per-step NaN/Inf scan of updated state + fetches
+    # (reference: FLAGS_check_nan_inf, operator.cc:950)
+    "check_nan_inf": (bool, False, "scan step outputs for NaN/Inf"),
+    # block until device work finishes each step, for honest timing
+    # (reference: FLAGS_benchmark forced dev_ctx->Wait, operator.cc:946)
+    "benchmark": (bool, False, "synchronize after every step"),
+    # executor compile-cache capacity (entries); 0 = unbounded
+    "executor_cache_capacity": (int, 0, "compiled-step cache entries"),
+    # coordination-service RPC deadline (reference: FLAGS_rpc_deadline)
+    "rpc_deadline_ms": (int, 60_000, "coord/KV operation deadline"),
+}
+
+_values: Dict[str, Any] = {}
+
+
+def _parse(ty, raw: str):
+    if ty is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    return ty(raw)
+
+
+def _bootstrap():
+    for name, (ty, default, _doc) in _DEFS.items():
+        raw = os.environ.get(f"PT_FLAGS_{name}")
+        if raw is None:
+            raw = os.environ.get(f"FLAGS_{name}")
+        _values[name] = _parse(ty, raw) if raw is not None else default
+
+
+def get_flag(name: str):
+    if name not in _DEFS:
+        raise KeyError(f"unknown flag '{name}'; known: {sorted(_DEFS)}")
+    return _values[name]
+
+
+def get_flags(names=None) -> Dict[str, Any]:
+    if names is None:
+        return dict(_values)
+    return {n: get_flag(n) for n in names}
+
+
+def set_flags(flags: Dict[str, Any]):
+    for name, v in flags.items():
+        if name not in _DEFS:
+            raise KeyError(f"unknown flag '{name}'; known: {sorted(_DEFS)}")
+        ty = _DEFS[name][0]
+        _values[name] = _parse(ty, v) if isinstance(v, str) else ty(v)
+
+
+_bootstrap()

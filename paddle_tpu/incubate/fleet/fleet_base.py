@@ -43,11 +43,16 @@ class Fleet:
     # --- lifecycle (reference: fleet_base.py init/init_worker) ---
 
     def init(self, role_maker: Optional[RoleMakerBase] = None,
-             connect_timeout_ms: int = 60_000):
+             connect_timeout_ms: Optional[int] = None):
         """Rendezvous + distributed runtime init. Single-worker jobs
-        (worker_num == 1) need no endpoints and become a no-op."""
+        (worker_num == 1) need no endpoints and become a no-op.
+        ``connect_timeout_ms`` defaults to the ``rpc_deadline_ms`` flag."""
         if self._initialized:
             return self
+        if connect_timeout_ms is None:
+            from paddle_tpu import flags as _flags
+
+            connect_timeout_ms = _flags.get_flag("rpc_deadline_ms")
         self._role = role_maker or EnvRoleMaker()
         n = self._role.worker_num()
         if n > 1:
@@ -120,9 +125,13 @@ class Fleet:
             raise RuntimeError("fleet.init with multiple workers first")
         self._client.put(key, value)
 
-    def get(self, key: str, timeout_ms: int = -1) -> bytes:
+    def get(self, key: str, timeout_ms: Optional[int] = None) -> bytes:
         if self._client is None:
             raise RuntimeError("fleet.init with multiple workers first")
+        if timeout_ms is None:
+            from paddle_tpu import flags as _flags
+
+            timeout_ms = _flags.get_flag("rpc_deadline_ms")
         return self._client.get(key, timeout_ms=timeout_ms)
 
     # --- failure detection (SURVEY.md section 5) ---

@@ -11,6 +11,7 @@ listen_and_serv_op.cc:109) becomes sharded embedding tables + all-to-all
 reference lacks (SURVEY.md section 5).
 """
 
+from paddle_tpu.parallel import checkpoint  # noqa: F401
 from paddle_tpu.parallel.mesh import create_mesh, get_mesh, set_mesh  # noqa: F401
 from paddle_tpu.parallel.strategy import (  # noqa: F401
     DistributedStrategy,
