@@ -1,0 +1,134 @@
+"""Benchmark: ResNet-50 ImageNet-shape training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = achieved model FLOPs utilization / 0.35 (BASELINE.md target:
+>=35% MFU for ResNet-50 on v5e). Model definition:
+paddle_tpu/models/resnet.py (reference: benchmark/fluid/models/resnet.py:171),
+synthetic ImageNet input (reference: benchmark/fluid/imagenet_reader.py),
+bf16 AMP convs, full train step (fwd + autodiff + momentum) in one XLA
+computation.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+V5E_PEAK_BF16 = 197e12  # FLOP/s per v5e chip
+
+BATCH = 128
+SHAPE = (3, 224, 224)
+CLASSES = 1000
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def resnet50_fwd_flops_per_image() -> float:
+    """Analytic conv+fc FLOPs (2*MACs) for ResNet-50 at 224x224 (~4.1e9,
+    the standard figure). Computed from the architecture so the number is
+    auditable rather than folklore."""
+    total = 0.0
+
+    def conv(hw, cin, cout, k, stride=1):
+        nonlocal total
+        out_hw = hw // stride
+        total += 2.0 * out_hw * out_hw * cout * cin * k * k
+        return out_hw
+
+    hw = conv(224, 3, 64, 7, 2)     # conv1 -> 112
+    hw //= 2                        # maxpool -> 56
+    cin = 64
+    for filters, blocks, first_stride in (
+        (64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2),
+    ):
+        for b in range(blocks):
+            stride = first_stride if b == 0 else 1
+            # bottleneck: 1x1 reduce, 3x3, 1x1 expand (+ projection on b==0)
+            conv(hw, cin, filters, 1)
+            new_hw = conv(hw, filters, filters, 3, stride)
+            conv(new_hw, filters, filters * 4, 1)
+            if b == 0:
+                conv(hw, cin, filters * 4, 1, stride)
+            hw = new_hw
+            cin = filters * 4
+    total += 2.0 * cin * CLASSES    # fc
+    return total
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.dataset import imagenet
+    from paddle_tpu.models import resnet
+
+    log(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        model = resnet.get_model(data_shape=SHAPE, class_dim=CLASSES,
+                                 depth=50)
+        fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(model["loss"])
+    main_prog._amp = True  # bf16 convs/matmuls, f32 master weights
+
+    exe = fluid.Executor()
+    exe.run(startup)
+
+    batch = BATCH
+    while batch >= 8:
+        try:
+            feed = next(iter(imagenet.batched(batch, 1)()))
+            t0 = time.time()
+            exe.run(main_prog, feed=feed, fetch_list=[model["loss"]])
+            log(f"compile+first step: {time.time() - t0:.1f}s (batch={batch})")
+            break
+        except Exception as e:
+            msg = f"{type(e).__name__}: {e}"
+            if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg:
+                raise
+            log(f"batch {batch} OOM; halving")
+            batch //= 2
+            exe = fluid.Executor()
+            exe.run(startup)
+    else:
+        print(json.dumps({"metric": "resnet50_train", "value": 0,
+                          "unit": "images/sec", "vs_baseline": 0.0}))
+        return
+
+    feeds = [
+        {k: jax.device_put(v) for k, v in fd.items()}
+        for fd in imagenet.batched(batch, 4, seed=33)()
+    ]
+    for fd in feeds[:2]:
+        exe.run(main_prog, feed=fd, fetch_list=[model["loss"]])
+    steps = 20
+    t0 = time.time()
+    loss = None
+    for i in range(steps):
+        loss = exe.run(main_prog, feed=feeds[i % 4],
+                       fetch_list=[model["loss"]], return_numpy=False)
+    loss_v = float(np.asarray(loss[0]))  # sync once
+    elapsed = time.time() - t0
+    log(f"{steps} steps in {elapsed:.2f}s, loss={loss_v:.3f}")
+
+    images_per_sec = batch * steps / elapsed
+    train_flops = 3.0 * resnet50_fwd_flops_per_image()  # bwd ~= 2x fwd
+    mfu = images_per_sec * train_flops / V5E_PEAK_BF16
+    log(f"images/sec={images_per_sec:.1f}, "
+        f"train GFLOP/image={train_flops / 1e9:.2f}, MFU={mfu:.3f}")
+
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(mfu / 0.35, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

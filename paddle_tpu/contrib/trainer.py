@@ -11,6 +11,7 @@ so the same Trainer resumes TP/DP-sharded state bit-exact.
 from __future__ import annotations
 
 import os
+import re
 import shutil
 from typing import Callable, List, Optional, Sequence
 
@@ -150,12 +151,16 @@ class Trainer:
             _ckpt.save_scope(cfg.checkpoint_dir, self.scope, step=serial)
         finally:
             self.scope.drop(_RNG_STEP_KEY)
-        # prune old serial dirs beyond max_num_checkpoints
+        # prune old serial dirs beyond max_num_checkpoints (foreign
+        # entries like checkpoint_best are not ours to touch)
         kept = sorted(
             (
-                int(d.split("_", 1)[1])
-                for d in os.listdir(cfg.checkpoint_dir)
-                if d.startswith("checkpoint_")
+                int(m.group(1))
+                for m in (
+                    re.match(r"checkpoint_(\d+)$", d)
+                    for d in os.listdir(cfg.checkpoint_dir)
+                )
+                if m
             ),
             reverse=True,
         )[cfg.max_num_checkpoints:]
@@ -202,6 +207,11 @@ class Trainer:
                         fetch_list=fetch,
                     )
                     handler(EndStepEvent(epoch, step, metrics))
+                if self._stopped:
+                    # stopped mid-epoch: the epoch did NOT complete — no
+                    # EndEpochEvent and no checkpoint, or resume would
+                    # silently skip the untrained remainder of it.
+                    break
                 handler(EndEpochEvent(epoch))
                 if (
                     self._ckpt_cfg is not None

@@ -9,6 +9,8 @@ throughput-oriented, so synthetic data measures the same compute.
 
 from paddle_tpu.dataset import (  # noqa: F401
     cifar,
+    flowers,
+    imagenet,
     imdb,
     mnist,
     uci_housing,

@@ -135,6 +135,11 @@ class Executor:
         from paddle_tpu import profiler as _profiler
 
         entry = self._cache.get(key) if use_program_cache else None
+        if entry is not None:
+            # LRU: refresh insertion order so capacity eviction drops the
+            # coldest entry, not the oldest-compiled (hot train step)
+            self._cache.pop(key)
+            self._cache[key] = entry
         if entry is None:
             with _profiler.record_event("executor.compile"):
                 entry = self._compile(

@@ -23,8 +23,11 @@ _DEFS: Dict[str, tuple] = {
     "benchmark": (bool, False, "synchronize after every step"),
     # executor compile-cache capacity (entries); 0 = unbounded
     "executor_cache_capacity": (int, 0, "compiled-step cache entries"),
-    # coordination-service RPC deadline (reference: FLAGS_rpc_deadline)
-    "rpc_deadline_ms": (int, 60_000, "coord/KV operation deadline"),
+    # coordination-service RPC deadline (reference: FLAGS_rpc_deadline,
+    # default 180s). Generous default: rendezvous keys are often published
+    # only after a peer's multi-minute first compile. Pass timeout_ms=-1
+    # to a specific call for block-forever.
+    "rpc_deadline_ms": (int, 600_000, "coord/KV operation deadline"),
 }
 
 _values: Dict[str, Any] = {}

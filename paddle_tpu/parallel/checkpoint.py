@@ -142,13 +142,49 @@ def latest_step(dirname: str) -> Optional[int]:
         return None
 
 
+def available_steps(dirname: str) -> List[int]:
+    import re
+
+    out = []
+    try:
+        for d in os.listdir(dirname):
+            m = re.match(r"checkpoint_(\d+)$", d)
+            if m:
+                out.append(int(m.group(1)))
+    except OSError:
+        pass
+    return sorted(out)
+
+
 def load_checkpoint(dirname: str, step: Optional[int] = None) -> Dict[str, np.ndarray]:
     """Reassemble {name -> full numpy array} from all processes' shard
-    files of ``checkpoint_<step>`` (default: the ``latest`` pointer)."""
-    if step is None:
-        step = latest_step(dirname)
-        if step is None:
-            raise FileNotFoundError(f"no 'latest' pointer in {dirname}")
+    files of ``checkpoint_<step>`` (default: the ``latest`` pointer).
+
+    Default-load resilience: multi-host saves have no cross-host commit
+    barrier (process 0 publishes ``latest`` after writing only ITS files),
+    so if the newest checkpoint is incomplete — a preemption hit mid-save —
+    older serials are tried before giving up."""
+    if step is not None:
+        return _load_one(dirname, step)
+    latest = latest_step(dirname)
+    if latest is None:
+        raise FileNotFoundError(f"no 'latest' pointer in {dirname}")
+    candidates = [latest] + [
+        s for s in reversed(available_steps(dirname)) if s != latest
+    ]
+    last_err: Optional[Exception] = None
+    for s in candidates:
+        try:
+            return _load_one(dirname, s)
+        except (IOError, KeyError) as e:
+            last_err = e
+    raise IOError(
+        f"no complete checkpoint in {dirname} "
+        f"(tried {candidates}): {last_err}"
+    )
+
+
+def _load_one(dirname: str, step: int) -> Dict[str, np.ndarray]:
     ckpt_dir = os.path.join(dirname, f"checkpoint_{step}")
     manifest: Dict[str, dict] = {}
     for fn in sorted(os.listdir(ckpt_dir)):

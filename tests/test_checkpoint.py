@@ -128,3 +128,26 @@ def test_async_save_and_latest_pointer(tmp_path):
 def test_missing_checkpoint_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         ckpt.load_checkpoint(str(tmp_path))
+
+
+def test_incomplete_latest_falls_back_to_previous(tmp_path):
+    """A torn newest checkpoint (no commit barrier across hosts) must not
+    brick resume when an older complete one exists (code-review finding,
+    round 2)."""
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ckpt.save_scope(str(tmp_path), scope, step=1)
+        ckpt.save_scope(str(tmp_path), scope, step=2)
+    # corrupt the newest: drop its shard payloads
+    import os
+
+    for fn in os.listdir(str(tmp_path / "checkpoint_2")):
+        if fn.startswith("shards_"):
+            os.remove(str(tmp_path / "checkpoint_2" / fn))
+    vals = ckpt.load_checkpoint(str(tmp_path))  # falls back to step 1
+    assert vals
+    with pytest.raises((IOError, KeyError)):
+        ckpt.load_checkpoint(str(tmp_path), step=2)  # explicit still raises

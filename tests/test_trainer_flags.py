@@ -257,3 +257,58 @@ def test_executor_cache_capacity_flag():
         assert len(exe._cache) == 2
     finally:
         flags.set_flags({"executor_cache_capacity": 0})
+
+
+def test_stop_mid_epoch_does_not_checkpoint(tmp_path):
+    """stop() inside an epoch must not mark the epoch complete
+    (code-review finding, round 2)."""
+    cfg = CheckpointConfig(str(tmp_path))
+    trainer = Trainer(_train_func, _optimizer_func, fluid.CPUPlace(),
+                      checkpoint_config=cfg)
+    events = []
+
+    def handler(event):
+        events.append(type(event).__name__)
+        if isinstance(event, EndStepEvent) and event.step >= 1:
+            trainer.stop()
+
+    trainer.train(1, handler, _reader(), ["img", "label"])
+    assert "EndEpochEvent" not in events
+    from paddle_tpu.parallel import checkpoint as ckpt
+
+    assert ckpt.latest_step(str(tmp_path)) is None
+
+
+def test_foreign_checkpoint_dirs_tolerated(tmp_path):
+    import os
+
+    os.makedirs(str(tmp_path / "checkpoint_best"))
+    cfg = CheckpointConfig(str(tmp_path), max_num_checkpoints=1)
+    trainer = Trainer(_train_func, _optimizer_func, fluid.CPUPlace(),
+                      checkpoint_config=cfg)
+    trainer.train(2, None, _reader(), ["img", "label"])
+    assert (tmp_path / "checkpoint_best").exists()
+
+
+def test_executor_cache_lru_keeps_hot_entry():
+    flags.set_flags({"executor_cache_capacity": 2})
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[4], dtype="float32")
+            y = layers.scale(x, 2.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+        def run(b):
+            exe.run(main, feed={"x": np.ones((b, 4), np.float32)},
+                    fetch_list=[y])
+
+        run(1)               # hot entry (most recently inserted)
+        hot_key = list(exe._cache)[-1]
+        for b in (2, 3, 4):  # transient shapes, hot entry touched between
+            run(b)
+            run(1)
+        assert hot_key in exe._cache  # LRU kept the reused entry
+    finally:
+        flags.set_flags({"executor_cache_capacity": 0})

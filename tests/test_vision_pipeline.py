@@ -1,0 +1,82 @@
+"""Image pipeline + ResNet benchmark-path tests (reference:
+benchmark/fluid/models/resnet.py, imagenet_reader.py,
+python/paddle/dataset/flowers.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.dataset import flowers, imagenet
+
+
+def test_flowers_reader_contract():
+    it = flowers.train()()
+    img, label = next(it)
+    assert img.shape == (3, 224, 224) and img.dtype == np.float32
+    assert 0 <= label < flowers.NUM_CLASSES
+    # deterministic across instantiations
+    img2, label2 = next(flowers.train()())
+    np.testing.assert_array_equal(img, img2)
+    assert label == label2
+
+
+def test_imagenet_batched_reader():
+    batches = list(imagenet.batched(4, 3)())
+    assert len(batches) == 3
+    assert batches[0]["data"].shape == (4, 3, 224, 224)
+    assert batches[0]["label"].shape == (4, 1)
+    assert batches[0]["label"].dtype == np.int64
+
+
+def test_resnet50_imagenet_shape_trains_one_step():
+    """The bench program (ResNet-50, 224^2, momentum, AMP) runs a full
+    train step and produces a finite decreasing-capable loss."""
+    from paddle_tpu.models import resnet
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        model = resnet.get_model(data_shape=(3, 224, 224), class_dim=1000,
+                                 depth=50)
+        fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(model["loss"])
+    main._amp = True
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fd = next(iter(imagenet.batched(2, 1)()))
+        (loss,) = exe.run(main, feed=fd, fetch_list=[model["loss"]])
+    assert np.isfinite(loss).all()
+
+
+def test_resnet18_trains_and_grads_flow():
+    """Small ResNet-18 end-to-end: steps run, losses stay finite, and the
+    stem conv actually moves (gradients reach the bottom of the network).
+    Convergence on synthetic data in a handful of steps is flaky for conv
+    nets (see verify skill notes), so this checks mechanics, not accuracy."""
+    from paddle_tpu.models import resnet
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("data", shape=[3, 64, 64], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        logits = resnet.resnet_imagenet(img, class_dim=16, depth=18)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Momentum(0.01, momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        stem = [p.name for p in main.all_parameters()
+                if p.shape and len(p.shape) == 4][0]
+        w_before = np.array(scope.find_var(stem))
+        for step in range(4):
+            x = rng.uniform(-1, 1, (8, 3, 64, 64)).astype(np.float32)
+            y = rng.randint(0, 16, (8, 1)).astype(np.int64)
+            (l,) = exe.run(main, feed={"data": x, "label": y},
+                           fetch_list=[loss])
+            losses.append(float(l))
+        w_after = np.array(scope.find_var(stem))
+    assert np.isfinite(losses).all()
+    assert not np.allclose(w_before, w_after), "no gradient reached the stem"
