@@ -11,7 +11,6 @@ so the same Trainer resumes TP/DP-sharded state bit-exact.
 from __future__ import annotations
 
 import os
-import re
 import shutil
 from typing import Callable, List, Optional, Sequence
 
@@ -154,15 +153,7 @@ class Trainer:
         # prune old serial dirs beyond max_num_checkpoints (foreign
         # entries like checkpoint_best are not ours to touch)
         kept = sorted(
-            (
-                int(m.group(1))
-                for m in (
-                    re.match(r"checkpoint_(\d+)$", d)
-                    for d in os.listdir(cfg.checkpoint_dir)
-                )
-                if m
-            ),
-            reverse=True,
+            _ckpt.available_steps(cfg.checkpoint_dir), reverse=True
         )[cfg.max_num_checkpoints:]
         for s in kept:
             shutil.rmtree(

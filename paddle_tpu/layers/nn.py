@@ -31,6 +31,7 @@ __all__ = [
     "sequence_expand", "im2sequence", "batch_norm", "group_norm", "prelu",
     "flatten", "sums", "elementwise_mod", "elementwise_floordiv", "maxout",
     "mean_iou",
+    "linear_chain_crf", "crf_decoding", "warpctc", "edit_distance",
 ]
 
 
@@ -1057,3 +1058,84 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
         attrs={"kernels": fs, "strides": st},
     )
     return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """CRF loss layer (reference: layers/nn.py linear_chain_crf).
+    ``input`` [b, t, c] emissions, ``label`` [b, t]; creates the [c+2, c]
+    transition parameter. Returns the per-sequence NEGATIVE
+    log-likelihood [b, 1] (reference kernel semantics: minimize
+    ``mean(...)`` directly)."""
+    helper = LayerHelper("linear_chain_crf")
+    c = input.shape[-1]
+    trans = helper.create_parameter(
+        ParamAttr._to_attr(param_attr), shape=[c + 2, c], dtype=input.dtype,
+    )
+    ll = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"Emission": input, "Transition": trans, "Label": label}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(
+        "linear_chain_crf", inputs=inputs, outputs={"LogLikelihood": ll}
+    )
+    return ll
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None):
+    """Viterbi decode with a (shared, by ParamAttr name) transition
+    parameter (reference: layers/nn.py crf_decoding)."""
+    helper = LayerHelper("crf_decoding")
+    c = input.shape[-1]
+    trans = helper.create_parameter(
+        ParamAttr._to_attr(param_attr), shape=[c + 2, c], dtype=input.dtype,
+    )
+    out = helper.create_variable_for_type_inference(
+        dtype="int64", stop_gradient=True)
+    inputs = {"Emission": input, "Transition": trans}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(
+        "crf_decoding", inputs=inputs, outputs={"ViterbiPath": out}
+    )
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """CTC loss (reference: layers/nn.py warpctc). ``input`` [b, t, c]
+    unnormalized logits (batch-major; the reference's time-major LoD
+    convention becomes padded + length vectors)."""
+    helper = LayerHelper("warpctc")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"Logits": input, "Label": label}
+    if input_length is not None:
+        inputs["LogitsLength"] = input_length
+    if label_length is not None:
+        inputs["LabelLength"] = label_length
+    helper.append_op(
+        "warpctc", inputs=inputs, outputs={"Loss": out},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return out
+
+
+def edit_distance(input, label, normalized=True, input_length=None,
+                  label_length=None):
+    """Levenshtein distance per row (reference: layers/nn.py
+    edit_distance). Returns (distance [b, 1], seq_num [1])."""
+    helper = LayerHelper("edit_distance")
+    out = helper.create_variable_for_type_inference(
+        dtype="float32", stop_gradient=True)
+    num = helper.create_variable_for_type_inference(
+        dtype="int64", stop_gradient=True)
+    inputs = {"Hyps": input, "Refs": label}
+    if input_length is not None:
+        inputs["HypsLength"] = input_length
+    if label_length is not None:
+        inputs["RefsLength"] = label_length
+    helper.append_op(
+        "edit_distance", inputs=inputs,
+        outputs={"Out": out, "SequenceNum": num},
+        attrs={"normalized": normalized},
+    )
+    return out, num

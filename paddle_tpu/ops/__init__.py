@@ -11,6 +11,7 @@ from paddle_tpu.ops import (  # noqa: F401
     activation_ops,
     attention_ops,
     control_flow_ops,
+    crf_ops,
     decode_ops,
     math_ops,
     nn_ops,

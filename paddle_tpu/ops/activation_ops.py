@@ -91,3 +91,61 @@ def _hard_swish(ins, attrs):
 @register_op("logsigmoid")
 def _logsigmoid(ins, attrs):
     return {"Out": [jax.nn.log_sigmoid(_x(ins))]}
+
+
+# --- remaining reference activations (operators/activation_op.cc) ---
+
+
+@register_op("tanh_shrink")
+def _tanh_shrink(ins, attrs):
+    x = _x(ins)
+    return {"Out": [x - jnp.tanh(x)]}
+
+
+@register_op("softshrink")
+def _softshrink(ins, attrs):
+    x = _x(ins)
+    lam = attrs.get("lambda", 0.5)
+    return {"Out": [jnp.where(x > lam, x - lam,
+                              jnp.where(x < -lam, x + lam, 0.0))]}
+
+
+@register_op("hard_shrink")
+def _hard_shrink(ins, attrs):
+    x = _x(ins)
+    t = attrs.get("threshold", 0.5)
+    return {"Out": [jnp.where(jnp.abs(x) > t, x, 0.0)]}
+
+
+@register_op("brelu")
+def _brelu(ins, attrs):
+    x = _x(ins)
+    return {"Out": [jnp.clip(x, attrs.get("t_min", 0.0),
+                             attrs.get("t_max", 24.0))]}
+
+
+@register_op("soft_relu")
+def _soft_relu(ins, attrs):
+    x = _x(ins)
+    t = attrs.get("threshold", 40.0)
+    return {"Out": [jnp.log1p(jnp.exp(jnp.clip(x, -t, t)))]}
+
+
+@register_op("stanh")
+def _stanh(ins, attrs):
+    x = _x(ins)
+    a = attrs.get("scale_a", 0.67)
+    b = attrs.get("scale_b", 1.7159)
+    return {"Out": [b * jnp.tanh(a * x)]}
+
+
+@register_op("thresholded_relu")
+def _thresholded_relu(ins, attrs):
+    x = _x(ins)
+    t = attrs.get("threshold", 1.0)
+    return {"Out": [jnp.where(x > t, x, 0.0)]}
+
+
+@register_op("selu")
+def _selu(ins, attrs):
+    return {"Out": [jax.nn.selu(_x(ins))]}

@@ -1,0 +1,303 @@
+"""Structured-prediction ops: linear-chain CRF and CTC loss.
+
+TPU-native redesign of the reference's sequence-labeling operators
+(reference: operators/linear_chain_crf_op.cc, crf_decoding_op.cc,
+operators/warpctc_op.cc — the last wraps the external warp-ctc CUDA
+library, cmake/external/warpctc.cmake). Ragged LoD inputs become padded
+``[B, T, ...]`` batches + ``Length`` vectors; the dynamic-programming
+recursions (CRF forward, Viterbi, CTC alpha) are ``lax.scan`` loops in
+log-space, so XLA compiles them and — for the losses — the gradients fall
+out of scan's transpose: no hand-written backward kernels or external CTC
+library.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.registry import register_op
+
+NEG = -1e30
+
+
+def _lengths(ins, slot, t):
+    v = ins.get(slot)
+    ln = v[0] if v else None
+    if ln is None:
+        return None
+    if jnp.ndim(ln) > 1:
+        ln = jnp.squeeze(ln, axis=-1)
+    return ln.astype(jnp.int32)
+
+
+@register_op("linear_chain_crf", diff_inputs=("Emission", "Transition"))
+def _linear_chain_crf(ins, attrs):
+    """Negative log-likelihood of a linear-chain CRF.
+
+    inputs: Emission [B, T, C] unary scores; Transition [C+2, C] (row 0 =
+    start scores, row 1 = end scores, rows 2.. = pairwise a->b, matching
+    the reference's layout, linear_chain_crf_op.cc); Label [B, T] int;
+    Length [B] optional.
+    outputs: LogLikelihood [B, 1] — despite the (reference-inherited)
+    name, this is the NEGATIVE log-likelihood -log p(label|x), matching
+    the reference kernel's ``return -ll`` (linear_chain_crf_op.h:193):
+    minimize it directly.
+    """
+    em = ins["Emission"][0]
+    em = em.astype(jnp.promote_types(em.dtype, jnp.float32))
+    trans = ins["Transition"][0].astype(em.dtype)
+    label = ins["Label"][0]
+    if jnp.ndim(label) > 2:
+        label = jnp.squeeze(label, axis=-1)
+    b, t, c = jnp.shape(em)
+    lengths = _lengths(ins, "Length", t)
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    start, end, pair = trans[0], trans[1], trans[2:]
+
+    steps = jnp.arange(t)
+    live = steps[None, :] < lengths[:, None]            # [B, T]
+    is_last = steps[None, :] == (lengths[:, None] - 1)  # [B, T]
+
+    # --- partition function: log-space forward algorithm ---
+    alpha0 = start[None, :] + em[:, 0, :]               # [B, C]
+
+    def fwd(alpha, xs):
+        e_t, live_t, last_t = xs                        # [B,C],[B],[B]
+        # logsumexp over previous tag
+        scores = alpha[:, :, None] + pair[None, :, :]   # [B, Cprev, C]
+        new = jax.nn.logsumexp(scores, axis=1) + e_t
+        alpha = jnp.where(live_t[:, None], new, alpha)
+        # add end scores exactly once, at each row's last live step
+        alpha = alpha + jnp.where(last_t[:, None], end[None, :], 0.0)
+        return alpha, None
+
+    xs = (
+        jnp.swapaxes(em, 0, 1)[1:],                     # [T-1, B, C]
+        jnp.swapaxes(live, 0, 1)[1:],
+        jnp.swapaxes(is_last, 0, 1)[1:],
+    )
+    alpha0 = alpha0 + jnp.where(is_last[:, 0][:, None], end[None, :], 0.0)
+    alpha, _ = lax.scan(fwd, alpha0, xs)
+    log_z = jax.nn.logsumexp(alpha, axis=-1)            # [B]
+
+    # --- gold path score ---
+    lab = label.astype(jnp.int32)
+    emit = jnp.take_along_axis(em, lab[:, :, None], axis=2)[..., 0]  # [B,T]
+    emit_sum = jnp.sum(emit * live.astype(em.dtype), axis=1)
+    trans_pair = pair[lab[:, :-1], lab[:, 1:]]          # [B, T-1]
+    trans_sum = jnp.sum(
+        trans_pair * live[:, 1:].astype(em.dtype), axis=1
+    )
+    last_idx = jnp.maximum(lengths - 1, 0)
+    gold = (
+        emit_sum
+        + trans_sum
+        + start[lab[:, 0]]
+        + end[jnp.take_along_axis(lab, last_idx[:, None], axis=1)[:, 0]]
+    )
+    return {"LogLikelihood": [(log_z - gold)[:, None]]}
+
+
+@register_op("crf_decoding", no_grad=True)
+def _crf_decoding(ins, attrs):
+    """Viterbi decode (reference: operators/crf_decoding_op.cc).
+
+    inputs: Emission [B, T, C], Transition [C+2, C], Length [B] optional.
+    outputs: ViterbiPath [B, T] int64 (padding positions are 0).
+    """
+    em = ins["Emission"][0]
+    em = em.astype(jnp.promote_types(em.dtype, jnp.float32))
+    trans = ins["Transition"][0].astype(em.dtype)
+    b, t, c = jnp.shape(em)
+    lengths = _lengths(ins, "Length", t)
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    start, end, pair = trans[0], trans[1], trans[2:]
+
+    steps = jnp.arange(t)
+    live = steps[None, :] < lengths[:, None]
+    is_last = steps[None, :] == (lengths[:, None] - 1)
+
+    def step(delta, xs):
+        e_t, live_t, last_t = xs
+        scores = delta[:, :, None] + pair[None, :, :]   # [B, Cprev, C]
+        best_prev = jnp.argmax(scores, axis=1)          # [B, C]
+        new = jnp.max(scores, axis=1) + e_t
+        delta_new = jnp.where(live_t[:, None], new, delta)
+        # dead steps backtrack to themselves (identity pointer)
+        ptr = jnp.where(
+            live_t[:, None], best_prev, jnp.arange(c)[None, :]
+        )
+        delta_new = delta_new + jnp.where(
+            last_t[:, None], end[None, :], 0.0
+        )
+        return delta_new, ptr
+
+    delta0 = start[None, :] + em[:, 0, :]
+    delta0 = delta0 + jnp.where(is_last[:, 0][:, None], end[None, :], 0.0)
+    xs = (
+        jnp.swapaxes(em, 0, 1)[1:],
+        jnp.swapaxes(live, 0, 1)[1:],
+        jnp.swapaxes(is_last, 0, 1)[1:],
+    )
+    delta, ptrs = lax.scan(step, delta0, xs)            # ptrs [T-1, B, C]
+
+    best_last = jnp.argmax(delta, axis=-1)              # [B]
+
+    def back(tag, ptr_t):
+        prev = jnp.take_along_axis(ptr_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # ys[i] = tag at position i+1; the final carry is the position-0 tag
+    first, path_tail = lax.scan(back, best_last, ptrs, reverse=True)
+    path = jnp.concatenate(
+        [first[None, :], path_tail], axis=0
+    )                                                   # [T, B]
+    path = jnp.swapaxes(path, 0, 1)                     # [B, T]
+    return {"ViterbiPath": [(path * live).astype(jnp.int64)]}
+
+
+@register_op("warpctc", diff_inputs=("Logits",))
+def _warpctc(ins, attrs):
+    """CTC loss (reference: operators/warpctc_op.cc wrapping warp-ctc;
+    here the standard log-space alpha recursion under lax.scan).
+
+    inputs: Logits [B, T, C] unnormalized; Label [B, L] int (padded with
+    ``blank``); LogitsLength [B] optional; LabelLength [B] optional.
+    attrs: blank (default 0), norm_by_times (divide each loss by its
+    logit length).
+    outputs: Loss [B, 1] (positive NLL).
+    """
+    logits = ins["Logits"][0]
+    logits = logits.astype(jnp.promote_types(logits.dtype, jnp.float32))
+    label = ins["Label"][0].astype(jnp.int32)
+    b, t, c = jnp.shape(logits)
+    l = jnp.shape(label)[1]
+    blank = int(attrs.get("blank", 0))
+    logit_len = _lengths(ins, "LogitsLength", t)
+    if logit_len is None:
+        logit_len = jnp.full((b,), t, jnp.int32)
+    label_len = _lengths(ins, "LabelLength", l)
+    if label_len is None:
+        label_len = jnp.full((b,), l, jnp.int32)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)          # [B, T, C]
+
+    # extended label sequence: blank y1 blank y2 ... yL blank  (len 2L+1)
+    s = 2 * l + 1
+    ext = jnp.full((b, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(label)                    # odd positions
+    ext_len = 2 * label_len + 1
+
+    pos = jnp.arange(s)[None, :]
+    valid = pos < ext_len[:, None]                      # [B, S]
+    # allowed skip transition s-2 -> s: ext[s] != blank and != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :-2]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    def emit(logp_t, e):
+        # logp_t [B, C]; gather per extended position -> [B, S]
+        return jnp.take_along_axis(logp_t, e, axis=1)
+
+    a0 = jnp.full((b, s), NEG)
+    a0 = a0.at[:, 0].set(emit(logp[:, 0], ext)[:, 0])
+    a0 = a0.at[:, 1].set(
+        jnp.where(label_len > 0, emit(logp[:, 0], ext)[:, 1], NEG)
+    )
+
+    def step(alpha, xs):
+        logp_t, live_t = xs                             # [B, C], [B]
+        stay = alpha
+        prev1 = jnp.pad(
+            alpha, ((0, 0), (1, 0)), constant_values=NEG
+        )[:, :-1]
+        prev2 = jnp.pad(
+            alpha, ((0, 0), (2, 0)), constant_values=NEG
+        )[:, :-2]
+        prev2 = jnp.where(can_skip, prev2, NEG)
+        tot = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        new = tot + emit(logp_t, ext)
+        new = jnp.where(valid, new, NEG)
+        return jnp.where(live_t[:, None], new, alpha), None
+
+    live = (jnp.arange(t)[None, :] < logit_len[:, None])
+    xs = (jnp.swapaxes(logp, 0, 1)[1:], jnp.swapaxes(live, 0, 1)[1:])
+    alpha, _ = lax.scan(step, a0, xs)
+
+    idx_last = jnp.maximum(ext_len - 1, 0)
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(idx_last - 1, 0)[:, None], axis=1
+    )[:, 0]
+    # empty-label rows (ext_len == 1) have only the single blank path —
+    # a_prev would alias a_last and double-count it
+    a_prev = jnp.where(ext_len >= 2, a_prev, NEG)
+    ll = jnp.logaddexp(a_last, a_prev)
+    loss = -ll
+    if attrs.get("norm_by_times", False):
+        loss = loss / logit_len.astype(loss.dtype)
+    return {"Loss": [loss[:, None]]}
+
+
+@register_op("edit_distance", no_grad=True)
+def _edit_distance(ins, attrs):
+    """Levenshtein distance per row (reference:
+    operators/edit_distance_op.cc). Hyps [B, L1], Refs [B, L2] int padded;
+    HypsLength/RefsLength [B] optional; attr normalized divides by ref
+    length. outputs: Out [B, 1] f32, SequenceNum [1]."""
+    hyp = ins["Hyps"][0].astype(jnp.int32)
+    ref = ins["Refs"][0].astype(jnp.int32)
+    b, l1 = jnp.shape(hyp)
+    l2 = jnp.shape(ref)[1]
+    hlen = _lengths(ins, "HypsLength", l1)
+    if hlen is None:
+        hlen = jnp.full((b,), l1, jnp.int32)
+    rlen = _lengths(ins, "RefsLength", l2)
+    if rlen is None:
+        rlen = jnp.full((b,), l2, jnp.int32)
+
+    big = jnp.asarray(10**9, jnp.float32)
+
+    # DP over hyp positions; row = distances over ref prefix lengths
+    row0 = jnp.broadcast_to(
+        jnp.arange(l2 + 1, dtype=jnp.float32)[None, :], (b, l2 + 1)
+    )
+    # positions beyond this row's ref length are clamped to its length
+    row0 = jnp.minimum(row0, rlen[:, None].astype(jnp.float32))
+
+    def step(row, xs):
+        h_t, i = xs                                     # [B], scalar idx
+        i1 = (i + 1).astype(jnp.float32)
+        live_h = i < hlen                               # [B]
+        sub_cost = (h_t[:, None] != ref).astype(jnp.float32)  # [B, L2]
+
+        def inner(carry, j):
+            left = carry                                 # new[j] running
+            up = row[:, j + 1]
+            diag = row[:, j]
+            live_r = j < rlen
+            cand = jnp.minimum(
+                jnp.minimum(up + 1.0, left + 1.0),
+                diag + sub_cost[:, j],
+            )
+            val = jnp.where(live_r, cand, left)
+            return val, val
+
+        first = jnp.where(live_h, i1, row[:, 0])
+        _, cols = lax.scan(inner, first, jnp.arange(l2))
+        new = jnp.concatenate(
+            [first[None, :], cols], axis=0
+        ).T                                              # [B, L2+1]
+        return jnp.where(live_h[:, None], new, row), None
+
+    row, _ = lax.scan(step, row0, (jnp.swapaxes(hyp, 0, 1), jnp.arange(l1)))
+    dist = jnp.take_along_axis(row, rlen[:, None], axis=1)[:, 0]
+    if attrs.get("normalized", False):
+        dist = dist / jnp.maximum(rlen.astype(dist.dtype), 1.0)
+    return {
+        "Out": [dist[:, None]],
+        "SequenceNum": [jnp.asarray([b], jnp.int64)],
+    }

@@ -8,6 +8,7 @@ Broadcasting follows the reference's ``axis`` convention for elementwise ops
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import register_op
@@ -212,3 +213,89 @@ def _p_norm(ins, attrs):
     keepdim = attrs.get("keepdim", False)
     out = jnp.sum(jnp.abs(x) ** porder, axis=axis, keepdims=keepdim) ** (1.0 / porder)
     return {"Out": [out]}
+
+
+# --- pairwise / ranking / distribution losses (operators/*_loss_op.cc) ---
+
+
+@register_op("log_loss", diff_inputs=("Predicted",))
+def _log_loss(ins, attrs):
+    """-(y*log(p) + (1-y)*log(1-p)) (reference: log_loss_op.cc)."""
+    p = ins["Predicted"][0]
+    y = ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    out = -(y * jnp.log(p + eps) + (1.0 - y) * jnp.log(1.0 - p + eps))
+    return {"Loss": [out]}
+
+
+@register_op("rank_loss", diff_inputs=("Left", "Right"))
+def _rank_loss(ins, attrs):
+    """RankNet pairwise loss (reference: rank_loss_op.cc)."""
+    label = ins["Label"][0]
+    left, right = ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": [jnp.logaddexp(0.0, d) - label * d]}
+
+
+@register_op("margin_rank_loss", diff_inputs=("X1", "X2"))
+def _margin_rank_loss(ins, attrs):
+    """max(0, -label*(x1-x2)+margin) (reference: margin_rank_loss_op.cc)."""
+    label = ins["Label"][0]
+    x1, x2 = ins["X1"][0], ins["X2"][0]
+    m = attrs.get("margin", 0.0)
+    act = jnp.maximum(0.0, -label * (x1 - x2) + m)
+    return {"Out": [act], "Activated": [(act > 0).astype(x1.dtype)]}
+
+
+@register_op("hinge_loss", diff_inputs=("Logits",))
+def _hinge_loss(ins, attrs):
+    """max(0, 1 - (2y-1)*logit) (reference: hinge_loss_op.cc)."""
+    logits = ins["Logits"][0]
+    y = ins["Labels"][0]
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2.0 * y - 1.0) * logits)]}
+
+
+@register_op("kldiv_loss", diff_inputs=("X",))
+def _kldiv_loss(ins, attrs):
+    """KL(target || x) with x in log-space (reference: kldiv_loss_op.cc)."""
+    x = ins["X"][0]
+    t = ins["Target"][0]
+    out = t * (jnp.log(jnp.maximum(t, 1e-30)) - x)
+    out = jnp.where(t > 0, out, 0.0)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        out = jnp.mean(out)
+    elif red == "sum":
+        out = jnp.sum(out)
+    elif red == "batchmean":
+        out = jnp.sum(out) / jnp.shape(x)[0]
+    return {"Loss": [out]}
+
+
+@register_op("bpr_loss", diff_inputs=("X",))
+def _bpr_loss(ins, attrs):
+    """Bayesian personalized ranking loss over softmax scores
+    (reference: bpr_loss_op.cc). X [N, C] raw scores, Label [N, 1]."""
+    x = ins["X"][0]
+    label = ins["Label"][0]
+    if jnp.ndim(label) > 1:
+        label = jnp.squeeze(label, -1)
+    n, c = jnp.shape(x)
+    pos = jnp.take_along_axis(x, label[:, None].astype(jnp.int32), axis=1)
+    diff = pos - x                                     # [N, C]
+    lo = jnp.logaddexp(0.0, -diff)                     # -log(sigmoid(diff))
+    mask = jax.nn.one_hot(label, c, dtype=x.dtype)
+    out = jnp.sum(lo * (1.0 - mask), axis=1, keepdims=True) / (c - 1)
+    return {"Y": [out]}
+
+
+@register_op("cos_sim", diff_inputs=("X", "Y"))
+def _cos_sim(ins, attrs):
+    """Row-wise cosine similarity (reference: cos_sim_op.cc)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(
+        xn * yn, 1e-12
+    )
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}

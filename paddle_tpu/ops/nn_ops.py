@@ -418,3 +418,185 @@ def _group_norm(ins, attrs):
         "Mean": [jax.lax.stop_gradient(jnp.reshape(mean, (n, g)))],
         "Variance": [jax.lax.stop_gradient(jnp.reshape(var, (n, g)))],
     }
+
+
+@register_op(
+    "sync_batch_norm",
+    diff_inputs=("X", "Scale", "Bias"),
+    inplace={"MeanOut": "Mean", "VarianceOut": "Variance"},
+)
+def _sync_batch_norm(ins, attrs):
+    """Cross-device batch norm (reference: operators/sync_batch_norm_op.cu
+    — NCCL all-reduce of per-GPU partial sums). TPU-native: the kernel is
+    the ordinary batch_norm compute; under GSPMD data parallelism the
+    batch axis is sharded, so ``jnp.mean`` over it ALREADY reduces across
+    devices (XLA inserts the ICI all-reduce) — global statistics are the
+    default, not an extra op."""
+    return _batch_norm(ins, attrs)
+
+
+@register_op("norm", diff_inputs=("X",))
+def _norm(ins, attrs):
+    """L2-normalize along axis (reference: operators/norm_op.cc)."""
+    x = _x(ins)
+    axis = attrs.get("axis", 1)
+    eps = attrs.get("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": [x / n], "Norm": [n]}
+
+
+@register_op("affine_channel", diff_inputs=("X", "Scale", "Bias"))
+def _affine_channel(ins, attrs):
+    """Per-channel scale+shift (reference: affine_channel_op.cc)."""
+    x = _x(ins)
+    scale, bias = _x(ins, "Scale"), _x(ins, "Bias")
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else jnp.ndim(x) - 1
+    shape = [1] * jnp.ndim(x)
+    shape[c_axis] = jnp.shape(x)[c_axis]
+    return {"Out": [x * jnp.reshape(scale, shape) + jnp.reshape(bias, shape)]}
+
+
+@register_op("bilinear_interp", diff_inputs=("X",))
+def _bilinear_interp(ins, attrs):
+    """NCHW bilinear resize (reference: operators/interpolate_op.cc).
+    align_corners semantics follow the reference default (True)."""
+    x = _x(ins)
+    n, c, h, w = jnp.shape(x)
+    out_h = int(attrs.get("out_h", h))
+    out_w = int(attrs.get("out_w", w))
+    align = attrs.get("align_corners", True)
+    if align and out_h > 1:
+        ys = jnp.linspace(0.0, h - 1.0, out_h)
+    else:
+        ys = (jnp.arange(out_h) + 0.5) * h / out_h - 0.5
+    if align and out_w > 1:
+        xs = jnp.linspace(0.0, w - 1.0, out_w)
+    else:
+        xs = (jnp.arange(out_w) + 0.5) * w / out_w - 0.5
+    ys = jnp.clip(ys, 0, h - 1)
+    xs = jnp.clip(xs, 0, w - 1)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs - x0)[None, None, None, :]
+    g = lambda yy, xx: x[:, :, yy, :][:, :, :, xx]
+    out = (
+        g(y0, x0) * (1 - wy) * (1 - wx)
+        + g(y1, x0) * wy * (1 - wx)
+        + g(y0, x1) * (1 - wy) * wx
+        + g(y1, x1) * wy * wx
+    )
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("nearest_interp", diff_inputs=("X",))
+def _nearest_interp(ins, attrs):
+    """NCHW nearest-neighbor resize (reference: interpolate_op.cc)."""
+    x = _x(ins)
+    n, c, h, w = jnp.shape(x)
+    out_h = int(attrs.get("out_h", h))
+    out_w = int(attrs.get("out_w", w))
+    align = attrs.get("align_corners", True)
+    if align and out_h > 1:
+        ys = jnp.round(jnp.linspace(0.0, h - 1.0, out_h)).astype(jnp.int32)
+    else:
+        ys = jnp.floor(jnp.arange(out_h) * h / out_h).astype(jnp.int32)
+    if align and out_w > 1:
+        xs = jnp.round(jnp.linspace(0.0, w - 1.0, out_w)).astype(jnp.int32)
+    else:
+        xs = jnp.floor(jnp.arange(out_w) * w / out_w).astype(jnp.int32)
+    return {"Out": [x[:, :, ys, :][:, :, :, xs]]}
+
+
+@register_op("row_conv", diff_inputs=("X", "Filter"))
+def _row_conv(ins, attrs):
+    """Lookahead row convolution over time (reference: row_conv_op.cc).
+    X [B, T, D], Filter [future_len, D]."""
+    x = _x(ins)
+    f = _x(ins, "Filter")
+    k = jnp.shape(f)[0]
+    xp = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(xp[:, i : i + jnp.shape(x)[1], :] * f[i][None, None, :]
+              for i in range(k))
+    return {"Out": [out]}
+
+
+@register_op("temporal_shift", diff_inputs=("X",))
+def _temporal_shift(ins, attrs):
+    """Shift a fraction of channels across the segment (time) dim
+    (reference: temporal_shift_op.cc). X [N*T, C, H, W]."""
+    x = _x(ins)
+    seg = int(attrs.get("seg_num", 1))
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = jnp.shape(x)
+    n = nt // seg
+    x5 = jnp.reshape(x, (n, seg, c, h, w))
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    fwd = jnp.pad(x5[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0), (0, 0)))
+    bwd = jnp.pad(x5[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0), (0, 0)))
+    keep = x5[:, :, c2:]
+    out = jnp.concatenate([fwd, bwd, keep], axis=2)
+    return {"Out": [jnp.reshape(out, (nt, c, h, w))]}
+
+
+@register_op("grid_sampler", diff_inputs=("X", "Grid"))
+def _grid_sampler(ins, attrs):
+    """Bilinear sampling at normalized grid locations
+    (reference: grid_sampler_op.cc). X [N,C,H,W], Grid [N,Ho,Wo,2] in
+    [-1, 1]."""
+    x = _x(ins)
+    grid = _x(ins, "Grid")
+    n, c, h, w = jnp.shape(x)
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0     # [N, Ho, Wo]
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+
+    def sample(yy, xx):
+        # out-of-bound corners contribute ZERO, matching the reference's
+        # zero padding (grid_sampler_op.h) — not border clamping
+        inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yy = jnp.clip(yy, 0, h - 1)
+        xx = jnp.clip(xx, 0, w - 1)
+        bidx = jnp.arange(n)[:, None, None]
+        vals = x[bidx, :, yy, xx]                  # [N, Ho, Wo, C]
+        return vals * inb[..., None].astype(vals.dtype)
+
+    wx = gx - x0
+    wy = gy - y0
+    out = (
+        sample(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+        + sample(y1, x0) * (wy * (1 - wx))[..., None]
+        + sample(y0, x1) * ((1 - wy) * wx)[..., None]
+        + sample(y1, x1) * (wy * wx)[..., None]
+    )
+    return {"Output": [jnp.transpose(out, (0, 3, 1, 2)).astype(x.dtype)]}
+
+
+@register_op("auc", no_grad=True)
+def _auc(ins, attrs):
+    """Batch-local ROC-AUC via threshold buckets (reference:
+    operators/metrics/auc_op.cc; streaming state lives in metrics.Auc)."""
+    pred = _x(ins, "Predict")   # [N, 2] or [N, 1] prob of positive
+    label = _x(ins, "Label")
+    if jnp.ndim(label) > 1:
+        label = jnp.squeeze(label, -1)
+    p = pred[:, -1]
+    buckets = int(attrs.get("num_thresholds", 200))
+    idx = jnp.clip((p * buckets).astype(jnp.int32), 0, buckets - 1)
+    pos = jnp.zeros((buckets,)).at[idx].add(label.astype(jnp.float32))
+    neg = jnp.zeros((buckets,)).at[idx].add(1.0 - label.astype(jnp.float32))
+    # integrate from the highest threshold down
+    tp = jnp.cumsum(pos[::-1])
+    fp = jnp.cumsum(neg[::-1])
+    tot_pos = jnp.maximum(tp[-1], 1e-12)
+    tot_neg = jnp.maximum(fp[-1], 1e-12)
+    tpr = jnp.concatenate([jnp.zeros((1,)), tp / tot_pos])
+    fpr = jnp.concatenate([jnp.zeros((1,)), fp / tot_neg])
+    auc = jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0)
+    return {"AUC": [auc]}

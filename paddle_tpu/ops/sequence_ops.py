@@ -126,3 +126,142 @@ def _im2sequence(ins, attrs):
     ph, pw = jnp.shape(patches)[2], jnp.shape(patches)[3]
     out = jnp.transpose(patches, (0, 2, 3, 1)).reshape(n, ph * pw, -1)
     return {"Out": [out]}
+
+
+@register_op("sequence_pad", no_grad=False, diff_inputs=("X",))
+def _sequence_pad(ins, attrs):
+    """Mask-out positions past Length with PadValue (reference:
+    sequence_pad_op.cc — LoD->padded; here padded->cleanly-padded)."""
+    x = _x(ins)
+    pad = _x(ins, "PadValue")
+    if pad is None:
+        pad = jnp.zeros((), x.dtype)
+    mask = _mask_from(ins, x)
+    shape = jnp.shape(mask) + (1,) * (jnp.ndim(x) - 2)
+    m = jnp.reshape(mask, shape).astype(x.dtype)
+    # PadValue: scalar, or a time-step-shaped tensor (reference
+    # sequence_pad_op.cc accepts both); broadcast against trailing dims
+    pad = jnp.broadcast_to(pad, jnp.shape(x)[2:]) if jnp.ndim(pad) else pad
+    out = x * m + pad * (1 - m)
+    length = _x(ins, "Length")
+    if length is None:
+        length = jnp.full((jnp.shape(x)[0],), jnp.shape(x)[1], jnp.int64)
+    return {"Out": [out], "OutLength": [length.astype(jnp.int64)]}
+
+
+@register_op("sequence_unpad", diff_inputs=("X",))
+def _sequence_unpad(ins, attrs):
+    """Inverse of sequence_pad. Static shapes force the output to stay
+    padded [B, T, ...]; dead positions are zeroed and Length carries the
+    ragged structure (reference: sequence_unpad_op.cc)."""
+    x = _x(ins)
+    mask = _mask_from(ins, x)
+    shape = jnp.shape(mask) + (1,) * (jnp.ndim(x) - 2)
+    return {"Out": [x * jnp.reshape(mask, shape).astype(x.dtype)]}
+
+
+@register_op("sequence_concat", diff_inputs=("X",))
+def _sequence_concat(ins, attrs):
+    """Per-row concatenation of live prefixes (reference:
+    sequence_concat_op.cc concatenates LoD sequences row-wise).
+
+    inputs: X (multi) [B, Ti, ...]; Length (multi, aligned) [B].
+    outputs: Out [B, sum(Ti), ...], OutLength [B].
+    """
+    xs = ins["X"]
+    lengths = ins.get("Length", [])
+    b = jnp.shape(xs[0])[0]
+    feat = jnp.shape(xs[0])[2:]
+    t_tot = sum(jnp.shape(x)[1] for x in xs)
+    out = jnp.zeros((b, t_tot + 1) + tuple(feat), xs[0].dtype)
+    offset = jnp.zeros((b,), jnp.int32)
+    total = jnp.zeros((b,), jnp.int64)
+    rows = jnp.arange(b)[:, None]
+    for i, x in enumerate(xs):
+        t = jnp.shape(x)[1]
+        ln = lengths[i] if i < len(lengths) and lengths[i] is not None \
+            else jnp.full((b,), t)
+        if jnp.ndim(ln) > 1:
+            ln = jnp.squeeze(ln, -1)
+        ln = ln.astype(jnp.int32)
+        steps = jnp.arange(t)[None, :]
+        live = steps < ln[:, None]
+        # dead tokens write to the dump column t_tot
+        pos = jnp.where(live, offset[:, None] + steps, t_tot)
+        out = out.at[rows, pos].add(x)
+        offset = offset + ln
+        total = total + ln.astype(jnp.int64)
+    return {"Out": [out[:, :t_tot]], "OutLength": [total]}
+
+
+@register_op("sequence_slice", diff_inputs=("X",))
+def _sequence_slice(ins, attrs):
+    """Per-row subsequence [offset, offset+length) (reference:
+    sequence_slice_op.cc). Output keeps the padded T; tail is zeroed."""
+    x = _x(ins)
+    off = _x(ins, "Offset")
+    ln = _x(ins, "Length")
+    if jnp.ndim(off) > 1:
+        off = jnp.squeeze(off, -1)
+    if jnp.ndim(ln) > 1:
+        ln = jnp.squeeze(ln, -1)
+    t = jnp.shape(x)[1]
+    steps = jnp.arange(t)[None, :]
+    src = jnp.clip(off[:, None].astype(jnp.int32) + steps, 0, t - 1)
+    gathered = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (jnp.ndim(x) - 2)), axis=1
+    )
+    live = steps < ln[:, None]
+    m = live.reshape(live.shape + (1,) * (jnp.ndim(x) - 2))
+    return {"Out": [gathered * m.astype(x.dtype)],
+            "OutLength": [ln.astype(jnp.int64)]}
+
+
+@register_op("sequence_erase", no_grad=True)
+def _sequence_erase(ins, attrs):
+    """Remove the given token values and compact left (reference:
+    sequence_erase_op.cc). X [B, T] int; attr tokens: list of ints."""
+    x = _x(ins)
+    tokens = jnp.asarray(attrs.get("tokens", []), x.dtype)
+    mask = _mask_from(ins, x[..., None]).astype(bool)
+    keep = mask & ~jnp.isin(x, tokens)
+    new_pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    t = jnp.shape(x)[1]
+    out = jnp.zeros((jnp.shape(x)[0], t + 1), x.dtype)
+    rows = jnp.arange(jnp.shape(x)[0])[:, None]
+    pos = jnp.where(keep, new_pos, t)
+    out = out.at[rows, pos].set(jnp.where(keep, x, 0))
+    return {"Out": [out[:, :t]],
+            "OutLength": [keep.sum(axis=1).astype(jnp.int64)]}
+
+
+@register_op("sequence_enumerate", no_grad=True)
+def _sequence_enumerate(ins, attrs):
+    """Sliding windows of ids (reference: sequence_enumerate_op.cc).
+    X [B, T] -> Out [B, T, win]; positions past a row's length (or the
+    array edge) fill with pad_value."""
+    x = _x(ins)
+    win = int(attrs.get("win_size", 2))
+    pad = attrs.get("pad_value", 0)
+    b, t = jnp.shape(x)
+    mask = _mask_from(ins, x[..., None]).astype(bool)      # [B, T]
+    idx = jnp.arange(t)[None, :, None] + jnp.arange(win)[None, None, :]
+    padded_mask = jnp.pad(mask, ((0, 0), (0, win)))        # [B, T+win]
+    valid = (idx < t) & padded_mask[
+        jnp.arange(b)[:, None, None], jnp.clip(idx, 0, t + win - 1)
+    ]
+    vals = x[jnp.arange(b)[:, None, None], jnp.clip(idx, 0, t - 1)]
+    return {"Out": [jnp.where(valid, vals, pad)]}
+
+
+@register_op("sequence_expand_as", diff_inputs=("X",))
+def _sequence_expand_as(ins, attrs):
+    """Broadcast each row's vector across Y's live time steps
+    (reference: sequence_expand_as_op.cc). X [B, D], Y [B, T, ...]."""
+    x = _x(ins)
+    y = _x(ins, "Y")
+    mask = _mask_from(ins, y)
+    out = jnp.broadcast_to(
+        x[:, None, :], (jnp.shape(x)[0], jnp.shape(y)[1], jnp.shape(x)[-1])
+    )
+    return {"Out": [out * mask[:, :, None].astype(out.dtype)]}

@@ -355,3 +355,287 @@ def _dynamic_slice(ins, attrs):
     starts = (idx,) + (zero,) * (x.ndim - 1)
     out = lax.dynamic_slice(x, starts, sizes)
     return {"Out": [jnp.squeeze(out, 0)]}
+
+
+# --- remaining reference tensor/array ops ---
+
+
+@register_op("reverse", diff_inputs=("X",))
+def _reverse(ins, attrs):
+    return {"Out": [jnp.flip(_x(ins), axis=tuple(attrs.get("axis", [0])))]}
+
+
+@register_op("argsort", no_grad=True)
+def _argsort(ins, attrs):
+    x = _x(ins)
+    axis = attrs.get("axis", -1)
+    descending = attrs.get("descending", False)
+    idx = jnp.argsort(-x if descending else x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": [out], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("diag", no_grad=True)
+def _diag(ins, attrs):
+    return {"Out": [jnp.diag(_x(ins, "Diagonal"))]}
+
+
+@register_op("linspace", no_grad=True)
+def _linspace(ins, attrs):
+    start = jnp.reshape(_x(ins, "Start"), ())
+    stop = jnp.reshape(_x(ins, "Stop"), ())
+    num = int(attrs["num"])
+    dtype = attrs.get("dtype", "float32")
+    return {"Out": [jnp.linspace(start, stop, num, dtype=dtype)]}
+
+
+@register_op("gather_nd", diff_inputs=("X",))
+def _gather_nd(ins, attrs):
+    x, index = _x(ins), _x(ins, "Index")
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return {"Out": [x[idx]]}
+
+
+@register_op("scatter_nd_add", diff_inputs=("X", "Updates"))
+def _scatter_nd_add(ins, attrs):
+    x = _x(ins)
+    index = _x(ins, "Index")
+    updates = _x(ins, "Updates")
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return {"Out": [x.at[idx].add(updates)]}
+
+
+@register_op("pad2d", diff_inputs=("X",))
+def _pad2d(ins, attrs):
+    """NCHW spatial padding with constant/reflect/edge modes
+    (reference: pad2d_op.cc)."""
+    x = _x(ins)
+    t, b, l, r = attrs.get("paddings", [0, 0, 0, 0])
+    mode = {"constant": "constant", "reflect": "reflect",
+            "edge": "edge"}[attrs.get("mode", "constant")]
+    kw = {}
+    if mode == "constant":
+        kw["constant_values"] = attrs.get("pad_value", 0.0)
+    out = jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)), mode=mode, **kw)
+    return {"Out": [out]}
+
+
+@register_op("pad_constant_like", diff_inputs=("Y",))
+def _pad_constant_like(ins, attrs):
+    """Pad Y up to X's shape with pad_value
+    (reference: pad_constant_like_op.cc)."""
+    x, y = _x(ins), _x(ins, "Y")
+    pads = [(0, int(a) - int(b)) for a, b in zip(jnp.shape(x), jnp.shape(y))]
+    return {"Out": [jnp.pad(y, pads,
+                            constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("crop", diff_inputs=("X",))
+def _crop(ins, attrs):
+    """Crop a static-offset window (reference: crop_op.cc)."""
+    x = _x(ins)
+    offsets = attrs.get("offsets", [0] * jnp.ndim(x))
+    shape = attrs["shape"]
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[sl]]}
+
+
+@register_op("shuffle_channel", diff_inputs=("X",))
+def _shuffle_channel(ins, attrs):
+    """Channel shuffle for group convs (reference: shuffle_channel_op.cc)."""
+    x = _x(ins)
+    g = int(attrs.get("group", 1))
+    n, c, h, w = jnp.shape(x)
+    out = jnp.reshape(
+        jnp.swapaxes(jnp.reshape(x, (n, g, c // g, h, w)), 1, 2), (n, c, h, w)
+    )
+    return {"Out": [out]}
+
+
+@register_op("pixel_shuffle", diff_inputs=("X",))
+def _pixel_shuffle(ins, attrs):
+    """Depth-to-space upscaling (reference: pixel_shuffle_op.cc)."""
+    x = _x(ins)
+    r = int(attrs.get("upscale_factor", 1))
+    n, c, h, w = jnp.shape(x)
+    out = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+    out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+    return {"Out": [jnp.reshape(out, (n, c // (r * r), h * r, w * r))]}
+
+
+@register_op("space_to_depth", diff_inputs=("X",))
+def _space_to_depth(ins, attrs):
+    """Inverse of pixel shuffle (reference: space_to_depth_op.cc)."""
+    x = _x(ins)
+    r = int(attrs.get("blocksize", 1))
+    n, c, h, w = jnp.shape(x)
+    out = jnp.reshape(x, (n, c, h // r, r, w // r, r))
+    out = jnp.transpose(out, (0, 3, 5, 1, 2, 4))
+    return {"Out": [jnp.reshape(out, (n, c * r * r, h // r, w // r))]}
+
+
+@register_op("multiplex", diff_inputs=("X",))
+def _multiplex(ins, attrs):
+    """Row-wise select among candidate tensors by index
+    (reference: multiplex_op.cc)."""
+    xs = jnp.stack(ins["X"], axis=0)        # [K, B, ...]
+    ids = _x(ins, "Ids")
+    if jnp.ndim(ids) > 1:
+        ids = jnp.squeeze(ids, -1)
+    b = jnp.shape(xs)[1]
+    return {"Out": [xs[ids.astype(jnp.int32), jnp.arange(b)]]}
+
+
+@register_op("sampling_id", no_grad=True, needs_rng=True)
+def _sampling_id(ins, attrs, rng=None):
+    """Sample a column index per row from probability rows
+    (reference: sampling_id_op.cc)."""
+    x = _x(ins)
+    ids = jax.random.categorical(rng, jnp.log(jnp.maximum(x, 1e-30)), axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}
+
+
+@register_op("shard_index", no_grad=True)
+def _shard_index(ins, attrs):
+    """Map global ids to shard-local ids (reference: shard_index_op.cc)."""
+    x = _x(ins)
+    index_num = attrs["index_num"]
+    nshards = attrs["nshards"]
+    shard_id = attrs["shard_id"]
+    ignore = attrs.get("ignore_value", -1)
+    per = (index_num + nshards - 1) // nshards
+    in_shard = (x // per) == shard_id
+    return {"Out": [jnp.where(in_shard, x % per, ignore)]}
+
+
+@register_op("iou_similarity", no_grad=True)
+def _iou_similarity(ins, attrs):
+    """Pairwise IoU of two box sets [N,4] x [M,4] (xmin,ymin,xmax,ymax)
+    (reference: operators/detection/iou_similarity_op.cc)."""
+    x = _x(ins)         # [N, 4]
+    y = _x(ins, "Y")    # [M, 4]
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    ax = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    ay = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    return {"Out": [inter / jnp.maximum(ax[:, None] + ay[None, :] - inter,
+                                        1e-10)]}
+
+
+@register_op("box_coder", no_grad=True)
+def _box_coder(ins, attrs):
+    """Encode/decode boxes against priors (reference:
+    operators/detection/box_coder_op.cc). PriorBox [M,4], TargetBox
+    encode:[N,4] / decode:[N,M,4]."""
+    prior = _x(ins, "PriorBox")
+    target = _x(ins, "TargetBox")
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    one = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    px = prior[:, 0] + pw * 0.5
+    py = prior[:, 1] + ph * 0.5
+    if code_type.startswith("encode"):
+        tw = target[:, 2] - target[:, 0] + one
+        th = target[:, 3] - target[:, 1] + one
+        tx = target[:, 0] + tw * 0.5
+        ty = target[:, 1] + th * 0.5
+        ox = (tx[:, None] - px[None, :]) / pw[None, :]
+        oy = (ty[:, None] - py[None, :]) / ph[None, :]
+        ow = jnp.log(tw[:, None] / pw[None, :])
+        oh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)     # [N, M, 4]
+    else:
+        tx = target[..., 0] * pw[None, :] + px[None, :]
+        ty = target[..., 1] * ph[None, :] + py[None, :]
+        tw = jnp.exp(target[..., 2]) * pw[None, :]
+        th = jnp.exp(target[..., 3]) * ph[None, :]
+        out = jnp.stack(
+            [tx - tw * 0.5, ty - th * 0.5,
+             tx + tw * 0.5 - one, ty + th * 0.5 - one], axis=-1)
+    return {"OutputBox": [out]}
+
+
+@register_op("flatten")
+def _flatten(ins, attrs):
+    # same semantics as flatten2 minus the XShape output
+    return {"Out": _flatten2(ins, attrs)["Out"]}
+
+
+@register_op("prior_box", no_grad=True)
+def _prior_box(ins, attrs):
+    """SSD prior boxes per feature-map cell (reference:
+    operators/detection/prior_box_op.cc). Input [N,C,H,W] feature map,
+    Image [N,C,Hi,Wi]. Outputs Boxes/Variances [H, W, P, 4]."""
+    feat = _x(ins, "Input")
+    img = _x(ins, "Image")
+    h, w = jnp.shape(feat)[2], jnp.shape(feat)[3]
+    ih, iw = jnp.shape(img)[2], jnp.shape(img)[3]
+    min_sizes = list(attrs.get("min_sizes", [100.0]))
+    max_sizes = list(attrs.get("max_sizes", []))
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", []):
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if attrs.get("flip", True):
+                ars.append(1.0 / ar)
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    step_w = attrs.get("step_w", 0.0) or float(iw) / w
+    step_h = attrs.get("step_h", 0.0) or float(ih) / h
+    offset = attrs.get("offset", 0.5)
+
+    whs = []
+    for ms in min_sizes:
+        for ar in ars:
+            whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
+        for xs in max_sizes:
+            whs.append((((ms * xs) ** 0.5), ((ms * xs) ** 0.5)))
+    p = len(whs)
+    cw = jnp.asarray([a for a, _ in whs]) / iw    # [P]
+    ch = jnp.asarray([b for _, b in whs]) / ih
+    cx = (jnp.arange(w) + offset) * step_w / iw   # [W]
+    cy = (jnp.arange(h) + offset) * step_h / ih   # [H]
+    cxg = jnp.broadcast_to(cx[None, :, None], (h, w, p))
+    cyg = jnp.broadcast_to(cy[:, None, None], (h, w, p))
+    boxes = jnp.stack([
+        cxg - cw / 2, cyg - ch / 2, cxg + cw / 2, cyg + ch / 2
+    ], axis=-1)
+    if attrs.get("clip", True):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), (h, w, p, 4))
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+@register_op("anchor_generator", no_grad=True)
+def _anchor_generator(ins, attrs):
+    """RPN anchors per cell (reference:
+    operators/detection/anchor_generator_op.cc). Outputs
+    Anchors/Variances [H, W, A, 4] in input-image pixels."""
+    feat = _x(ins, "Input")
+    h, w = jnp.shape(feat)[2], jnp.shape(feat)[3]
+    sizes = attrs.get("anchor_sizes", [64.0, 128.0, 256.0, 512.0])
+    ratios = attrs.get("aspect_ratios", [0.5, 1.0, 2.0])
+    variances = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    stride = attrs.get("stride", [16.0, 16.0])
+    offset = attrs.get("offset", 0.5)
+    whs = []
+    for r in ratios:
+        for s in sizes:
+            area = s * s
+            aw = (area / r) ** 0.5
+            whs.append((aw, aw * r))
+    a = len(whs)
+    aw = jnp.asarray([x for x, _ in whs])
+    ah = jnp.asarray([y for _, y in whs])
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    cxg = jnp.broadcast_to(cx[None, :, None], (h, w, a))
+    cyg = jnp.broadcast_to(cy[:, None, None], (h, w, a))
+    anchors = jnp.stack([
+        cxg - aw / 2, cyg - ah / 2, cxg + aw / 2, cyg + ah / 2
+    ], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances), (h, w, a, 4))
+    return {"Anchors": [anchors], "Variances": [var]}

@@ -176,7 +176,9 @@ def load_checkpoint(dirname: str, step: Optional[int] = None) -> Dict[str, np.nd
     for s in candidates:
         try:
             return _load_one(dirname, s)
-        except (IOError, KeyError) as e:
+        except Exception as e:  # noqa: BLE001 — any torn-file failure
+            # (missing files, truncated npz -> BadZipFile, cut-off JSON)
+            # means "this serial is incomplete, try the next one"
             last_err = e
     raise IOError(
         f"no complete checkpoint in {dirname} "

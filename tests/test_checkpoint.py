@@ -151,3 +151,24 @@ def test_incomplete_latest_falls_back_to_previous(tmp_path):
     assert vals
     with pytest.raises((IOError, KeyError)):
         ckpt.load_checkpoint(str(tmp_path), step=2)  # explicit still raises
+
+
+def test_truncated_shard_file_falls_back(tmp_path):
+    """A TRUNCATED (not just missing) shard file must also trigger the
+    fallback (code-review finding, round 2: BadZipFile is not IOError)."""
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ckpt.save_scope(str(tmp_path), scope, step=1)
+        ckpt.save_scope(str(tmp_path), scope, step=2)
+    import os
+
+    for fn in os.listdir(str(tmp_path / "checkpoint_2")):
+        if fn.startswith("shards_"):
+            p = str(tmp_path / "checkpoint_2" / fn)
+            with open(p, "r+b") as f:
+                f.truncate(20)  # torn write
+    vals = ckpt.load_checkpoint(str(tmp_path))
+    assert vals  # fell back to checkpoint_1
