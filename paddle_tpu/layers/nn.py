@@ -1083,7 +1083,9 @@ def linear_chain_crf(input, label, param_attr=None, length=None):
 
 def crf_decoding(input, param_attr=None, label=None, length=None):
     """Viterbi decode with a (shared, by ParamAttr name) transition
-    parameter (reference: layers/nn.py crf_decoding)."""
+    parameter (reference: layers/nn.py crf_decoding). With ``label``, the
+    output switches to the reference's per-position correctness mask
+    (1 where the Viterbi path agrees with the label) instead of tag ids."""
     helper = LayerHelper("crf_decoding")
     c = input.shape[-1]
     trans = helper.create_parameter(
@@ -1097,7 +1099,14 @@ def crf_decoding(input, param_attr=None, label=None, length=None):
     helper.append_op(
         "crf_decoding", inputs=inputs, outputs={"ViterbiPath": out}
     )
-    return out
+    if label is None:
+        return out
+    correct = helper.create_variable_for_type_inference(
+        dtype="int64", stop_gradient=True)
+    helper.append_op(
+        "equal", inputs={"X": out, "Y": label}, outputs={"Out": correct}
+    )
+    return cast(correct, "int64")
 
 
 def warpctc(input, label, blank=0, norm_by_times=False,

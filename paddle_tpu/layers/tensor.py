@@ -12,7 +12,7 @@ from paddle_tpu.layer_helper import LayerHelper
 __all__ = [
     "create_tensor", "create_parameter", "create_global_var", "fill_constant",
     "assign", "zeros", "ones", "zeros_like", "ones_like", "range_",
-    "linspace", "uniform_random", "gaussian_random", "shape",
+    "linspace", "uniform_random", "gaussian_random", "shape", "slice",
 ]
 
 
@@ -160,4 +160,16 @@ def shape(input):
     helper = LayerHelper("shape")
     out = helper.create_variable_for_type_inference(dtype="int64", stop_gradient=True)
     helper.append_op("shape", inputs={"X": input}, outputs={"Out": out})
+    return out
+
+
+def slice(input, axes, starts, ends):
+    """Static slicing (reference: layers/nn.py slice / slice_op.cc)."""
+    helper = LayerHelper("slice")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        "slice", inputs={"X": input}, outputs={"Out": out},
+        attrs={"axes": list(axes), "starts": list(starts),
+               "ends": list(ends)},
+    )
     return out

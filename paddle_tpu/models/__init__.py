@@ -1,3 +1,12 @@
 """Model zoo (reference: benchmark/fluid/models/ + tests/book models)."""
 
-from paddle_tpu.models import mnist, resnet, transformer, vgg  # noqa: F401
+from paddle_tpu.models import (  # noqa: F401
+    bert,
+    deepfm,
+    mnist,
+    resnet,
+    se_resnext,
+    seq2seq,
+    transformer,
+    vgg,
+)

@@ -259,8 +259,6 @@ def _edit_distance(ins, attrs):
     if rlen is None:
         rlen = jnp.full((b,), l2, jnp.int32)
 
-    big = jnp.asarray(10**9, jnp.float32)
-
     # DP over hyp positions; row = distances over ref prefix lengths
     row0 = jnp.broadcast_to(
         jnp.arange(l2 + 1, dtype=jnp.float32)[None, :], (b, l2 + 1)

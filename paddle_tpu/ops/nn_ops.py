@@ -457,14 +457,26 @@ def _affine_channel(ins, attrs):
     return {"Out": [x * jnp.reshape(scale, shape) + jnp.reshape(bias, shape)]}
 
 
+def _interp_out_size(attrs, h, w):
+    """out_h/out_w attrs, or the reference's ``scale`` fallback
+    (interpolate_op.cc: out = in * scale when out_h/out_w unset)."""
+    out_h = int(attrs.get("out_h", 0) or 0)
+    out_w = int(attrs.get("out_w", 0) or 0)
+    scale = attrs.get("scale", 0.0)
+    if out_h <= 0:
+        out_h = int(h * scale) if scale else int(h)
+    if out_w <= 0:
+        out_w = int(w * scale) if scale else int(w)
+    return out_h, out_w
+
+
 @register_op("bilinear_interp", diff_inputs=("X",))
 def _bilinear_interp(ins, attrs):
     """NCHW bilinear resize (reference: operators/interpolate_op.cc).
     align_corners semantics follow the reference default (True)."""
     x = _x(ins)
     n, c, h, w = jnp.shape(x)
-    out_h = int(attrs.get("out_h", h))
-    out_w = int(attrs.get("out_w", w))
+    out_h, out_w = _interp_out_size(attrs, h, w)
     align = attrs.get("align_corners", True)
     if align and out_h > 1:
         ys = jnp.linspace(0.0, h - 1.0, out_h)
@@ -497,8 +509,7 @@ def _nearest_interp(ins, attrs):
     """NCHW nearest-neighbor resize (reference: interpolate_op.cc)."""
     x = _x(ins)
     n, c, h, w = jnp.shape(x)
-    out_h = int(attrs.get("out_h", h))
-    out_w = int(attrs.get("out_w", w))
+    out_h, out_w = _interp_out_size(attrs, h, w)
     align = attrs.get("align_corners", True)
     if align and out_h > 1:
         ys = jnp.round(jnp.linspace(0.0, h - 1.0, out_h)).astype(jnp.int32)

@@ -139,10 +139,13 @@ def _sequence_pad(ins, attrs):
     mask = _mask_from(ins, x)
     shape = jnp.shape(mask) + (1,) * (jnp.ndim(x) - 2)
     m = jnp.reshape(mask, shape).astype(x.dtype)
-    # PadValue: scalar, or a time-step-shaped tensor (reference
-    # sequence_pad_op.cc accepts both); broadcast against trailing dims
-    pad = jnp.broadcast_to(pad, jnp.shape(x)[2:]) if jnp.ndim(pad) else pad
-    out = x * m + pad * (1 - m)
+    # PadValue: scalar, shape-[1] tensor (the reference API's common
+    # spelling), or a time-step-shaped tensor (sequence_pad_op.cc)
+    if jnp.ndim(pad) and jnp.size(pad) == 1:
+        pad = jnp.reshape(pad, ())
+    if jnp.ndim(pad):
+        pad = jnp.broadcast_to(pad, jnp.shape(x)[2:])
+    out = x * m + pad.astype(x.dtype) * (1 - m)
     length = _x(ins, "Length")
     if length is None:
         length = jnp.full((jnp.shape(x)[0],), jnp.shape(x)[1], jnp.int64)

@@ -534,6 +534,14 @@ def _box_coder(ins, attrs):
     code_type = attrs.get("code_type", "encode_center_size")
     norm = attrs.get("box_normalized", True)
     one = 0.0 if norm else 1.0
+    # variances scale the encoded offsets (box_coder_op.h): per-prior
+    # tensor input, or a 4-vector attr, or none (all ones)
+    pvar = ins.get("PriorBoxVar", [None])
+    pvar = pvar[0] if pvar else None
+    if pvar is None:
+        va = attrs.get("variance", [])
+        pvar = jnp.asarray(va if va else [1.0, 1.0, 1.0, 1.0])
+        pvar = jnp.broadcast_to(pvar, (jnp.shape(prior)[0], 4))
     pw = prior[:, 2] - prior[:, 0] + one
     ph = prior[:, 3] - prior[:, 1] + one
     px = prior[:, 0] + pw * 0.5
@@ -543,16 +551,16 @@ def _box_coder(ins, attrs):
         th = target[:, 3] - target[:, 1] + one
         tx = target[:, 0] + tw * 0.5
         ty = target[:, 1] + th * 0.5
-        ox = (tx[:, None] - px[None, :]) / pw[None, :]
-        oy = (ty[:, None] - py[None, :]) / ph[None, :]
-        ow = jnp.log(tw[:, None] / pw[None, :])
-        oh = jnp.log(th[:, None] / ph[None, :])
+        ox = (tx[:, None] - px[None, :]) / pw[None, :] / pvar[None, :, 0]
+        oy = (ty[:, None] - py[None, :]) / ph[None, :] / pvar[None, :, 1]
+        ow = jnp.log(tw[:, None] / pw[None, :]) / pvar[None, :, 2]
+        oh = jnp.log(th[:, None] / ph[None, :]) / pvar[None, :, 3]
         out = jnp.stack([ox, oy, ow, oh], axis=-1)     # [N, M, 4]
     else:
-        tx = target[..., 0] * pw[None, :] + px[None, :]
-        ty = target[..., 1] * ph[None, :] + py[None, :]
-        tw = jnp.exp(target[..., 2]) * pw[None, :]
-        th = jnp.exp(target[..., 3]) * ph[None, :]
+        tx = target[..., 0] * pvar[None, :, 0] * pw[None, :] + px[None, :]
+        ty = target[..., 1] * pvar[None, :, 1] * ph[None, :] + py[None, :]
+        tw = jnp.exp(target[..., 2] * pvar[None, :, 2]) * pw[None, :]
+        th = jnp.exp(target[..., 3] * pvar[None, :, 3]) * ph[None, :]
         out = jnp.stack(
             [tx - tw * 0.5, ty - th * 0.5,
              tx + tw * 0.5 - one, ty + th * 0.5 - one], axis=-1)
@@ -588,11 +596,14 @@ def _prior_box(ins, attrs):
     offset = attrs.get("offset", 0.5)
 
     whs = []
-    for ms in min_sizes:
+    for i, ms in enumerate(min_sizes):
         for ar in ars:
             whs.append((ms * (ar ** 0.5), ms / (ar ** 0.5)))
-        for xs in max_sizes:
-            whs.append((((ms * xs) ** 0.5), ((ms * xs) ** 0.5)))
+        # max_sizes pair index-wise with min_sizes (prior_box_op.h):
+        # one extra sqrt(min*max) square prior per min size
+        if i < len(max_sizes):
+            s = (ms * max_sizes[i]) ** 0.5
+            whs.append((s, s))
     p = len(whs)
     cw = jnp.asarray([a for a, _ in whs]) / iw    # [P]
     ch = jnp.asarray([b for _, b in whs]) / ih

@@ -229,3 +229,24 @@ def test_warpctc_empty_label_row():
         out_slots=("Loss",),
     )
     h.check_output({"Loss": expected}, atol=1e-6)
+
+
+def test_crf_decoding_label_gives_correctness_mask():
+    """Reference semantics: with a label input, the layer returns per
+    position 1/0 agreement flags, not tag ids."""
+    b, t, c = 2, 3, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        em = layers.data("em", shape=[t, c], dtype="float32")
+        lab = layers.data("lab", shape=[t], dtype="int64")
+        path = layers.crf_decoding(
+            em, param_attr=fluid.ParamAttr(name="crfw2"))
+        mask = layers.crf_decoding(
+            em, param_attr=fluid.ParamAttr(name="crfw2"), label=lab)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    em_np = RS(8).randn(b, t, c).astype(np.float32)
+    lab_np = RS(9).randint(0, c, (b, t)).astype(np.int64)
+    p, m = exe.run(main, feed={"em": em_np, "lab": lab_np},
+                   fetch_list=[path, mask])
+    np.testing.assert_array_equal(m, (p == lab_np).astype(np.int64))

@@ -439,3 +439,55 @@ def test_sequence_pad_vector_pad_value():
     exp[0, 2:] = pv
     exp[1, 1:] = pv
     h.check_output({"Out": exp})
+
+
+def test_prior_box_max_size_index_pairing():
+    """max_sizes pair index-wise with min_sizes (code-review finding,
+    round 2): 2 min x (1+2 ars) + 2 paired max = 8 priors, not 10."""
+    from paddle_tpu.core.registry import get_op_def
+
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    outs = get_op_def("prior_box").compute(
+        {"Input": [feat], "Image": [img]},
+        {"min_sizes": [30.0, 60.0], "max_sizes": [60.0, 111.0],
+         "aspect_ratios": [2.0], "flip": True, "clip": False})
+    assert outs["Boxes"][0].shape == (2, 2, 8, 4)
+
+
+def test_box_coder_variances_roundtrip():
+    prior = np.array([[0.0, 0.0, 1.0, 1.0]], np.float64)
+    target = np.array([[0.25, 0.25, 0.75, 0.75]], np.float64)
+    var = [0.1, 0.1, 0.2, 0.2]
+    from paddle_tpu.core.registry import get_op_def
+
+    enc = np.asarray(get_op_def("box_coder").compute(
+        {"PriorBox": [prior], "TargetBox": [target]},
+        {"code_type": "encode_center_size", "variance": var})["OutputBox"][0])
+    np.testing.assert_allclose(
+        enc[0, 0], [0.0, 0.0, np.log(0.5) / 0.2, np.log(0.5) / 0.2])
+    dec = np.asarray(get_op_def("box_coder").compute(
+        {"PriorBox": [prior], "TargetBox": [enc]},
+        {"code_type": "decode_center_size", "variance": var})["OutputBox"][0])
+    np.testing.assert_allclose(dec[0, 0], target[0], atol=1e-12)
+
+
+def test_sequence_pad_2d_with_unit_pad_value():
+    x = np.array([[5, 6, 7], [8, 9, 1]], np.float64)
+    ln = np.array([2, 1], np.int64)
+    h = OpHarness("sequence_pad",
+                  {"X": x, "PadValue": np.array([0.5]), "Length": ln},
+                  out_slots=("Out",))
+    exp = x.copy()
+    exp[0, 2:] = 0.5
+    exp[1, 1:] = 0.5
+    h.check_output({"Out": exp})
+
+
+def test_interp_scale_attr():
+    from paddle_tpu.core.registry import get_op_def
+
+    x = RS(44).randn(1, 1, 2, 2)
+    out = np.asarray(get_op_def("nearest_interp").compute(
+        {"X": [x]}, {"scale": 2.0, "align_corners": False})["Out"][0])
+    np.testing.assert_allclose(out, x.repeat(2, 2).repeat(2, 3))
