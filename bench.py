@@ -20,8 +20,10 @@ import numpy as np
 
 V5E_PEAK_BF16 = 197e12  # FLOP/s per v5e chip
 
-BATCH = 32
-SEQ = 256
+import os
+
+BATCH = int(os.environ.get("PT_BENCH_BATCH", "64"))
+SEQ = int(os.environ.get("PT_BENCH_SEQ", "256"))
 VOCAB = 10000
 
 
@@ -52,6 +54,11 @@ def analytic_flops_per_step(cfg, batch, s, t):
 
 def main():
     import jax
+
+    # Persistent XLA compilation cache: repeat runs (same program/shapes)
+    # skip the multi-minute TPU compile entirely.
+    jax.config.update("jax_compilation_cache_dir", "/tmp/pt_jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     import paddle_tpu as fluid
     from paddle_tpu.models import transformer as T

@@ -27,6 +27,7 @@ from paddle_tpu.compiler import (  # noqa: F401
     CompiledProgram,
     ExecutionStrategy,
 )
+from paddle_tpu import inference  # noqa: F401
 from paddle_tpu.executor import (  # noqa: F401
     Executor,
     Scope,

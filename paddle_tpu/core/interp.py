@@ -15,6 +15,7 @@ same as top-level blocks under mixed precision.
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 from typing import Any, Dict, List, Optional
 
@@ -100,6 +101,22 @@ def spmd_ctx():
 
 def set_spmd_ctx(ctx):
     return _SPMD_CTX.set(ctx)
+
+
+@contextlib.contextmanager
+def spmd_ctx_scope(strategy):
+    """Activate a DistributedStrategy's SPMD context (ring attention /
+    sharded tables) for the enclosed trace. The single place that builds
+    the context tuple — keep kernels' destructuring in sync with it."""
+    ctx = None
+    if strategy is not None and (strategy.context_axis or strategy.table_axis):
+        ctx = (strategy.mesh, strategy.context_axis, strategy.table_axis,
+               strategy.data_axis)
+    tok = _SPMD_CTX.set(ctx)
+    try:
+        yield
+    finally:
+        _SPMD_CTX.reset(tok)
 
 
 def _is_f32(v):

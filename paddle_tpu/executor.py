@@ -79,6 +79,20 @@ def scope_guard(scope: Scope):
         _global_scope = old
 
 
+def _prng_impl():
+    """Program-level PRNG implementation. On TPU, threefry random-bit
+    generation is slow enough to dominate dropout (ablation: 21.5ms of a
+    63ms transformer step, benchmarks/ablate.py), so the hardware 'rbg'
+    generator is the default there; CPU keeps threefry so test streams
+    stay stable. Override with the 'prng_impl' flag."""
+    from paddle_tpu import flags as _flags
+
+    choice = _flags.get_flag("prng_impl")
+    if choice != "auto":
+        return choice
+    return "rbg" if jax.default_backend() == "tpu" else None
+
+
 class Executor:
     """Runs programs. ``place`` selects the default JAX device kind."""
 
@@ -165,7 +179,11 @@ class Executor:
             state[n] = v
 
         seed = program.random_seed if program.random_seed is not None else 0
-        rng = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
+        # typed key: carries its impl (rbg on TPU) through jit/fold_in,
+        # unlike the legacy raw-uint32 PRNGKey
+        rng = jax.random.fold_in(
+            jax.random.key(seed, impl=_prng_impl()), self._step
+        )
         self._step += 1
 
         if compiled is not None:
@@ -176,14 +194,9 @@ class Executor:
         # first jitted call.
         from paddle_tpu.core import interp as _interp
 
-        spmd_ctx = None
-        if compiled is not None and compiled._strategy is not None:
-            st = compiled._strategy
-            if st.context_axis or st.table_axis:
-                spmd_ctx = (st.mesh, st.context_axis, st.table_axis,
-                            st.data_axis)
-        tok = _interp.set_spmd_ctx(spmd_ctx)
-        with _profiler.record_event("executor.run_step"):
+        strategy = compiled._strategy if compiled is not None else None
+        with _interp.spmd_ctx_scope(strategy), \
+                _profiler.record_event("executor.run_step"):
             try:
                 fetches, new_state = fn(state, feed_vals, rng)
             except Exception:
@@ -197,8 +210,6 @@ class Executor:
                     if isinstance(v, jax.Array) and v.is_deleted():
                         scope.drop(n)
                 raise
-            finally:
-                _interp._SPMD_CTX.reset(tok)
         from paddle_tpu import flags as _flags
 
         if _flags.get_flag("benchmark"):

@@ -23,6 +23,9 @@ _DEFS: Dict[str, tuple] = {
     "benchmark": (bool, False, "synchronize after every step"),
     # executor compile-cache capacity (entries); 0 = unbounded
     "executor_cache_capacity": (int, 0, "compiled-step cache entries"),
+    # program-level PRNG: auto = rbg on TPU (fast hardware generator),
+    # threefry elsewhere; or force 'threefry2x32' / 'rbg' / 'unsafe_rbg'
+    "prng_impl": (str, "auto", "PRNG implementation for program RNG"),
     # coordination-service RPC deadline (reference: FLAGS_rpc_deadline,
     # default 180s). Generous default: rendezvous keys are often published
     # only after a peer's multi-minute first compile. Pass timeout_ms=-1

@@ -1101,12 +1101,7 @@ def crf_decoding(input, param_attr=None, label=None, length=None):
     )
     if label is None:
         return out
-    correct = helper.create_variable_for_type_inference(
-        dtype="int64", stop_gradient=True)
-    helper.append_op(
-        "equal", inputs={"X": out, "Y": label}, outputs={"Out": correct}
-    )
-    return cast(correct, "int64")
+    return cast(equal(out, label), "int64")
 
 
 def warpctc(input, label, blank=0, norm_by_times=False,

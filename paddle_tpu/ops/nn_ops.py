@@ -458,16 +458,15 @@ def _affine_channel(ins, attrs):
 
 
 def _interp_out_size(attrs, h, w):
-    """out_h/out_w attrs, or the reference's ``scale`` fallback
-    (interpolate_op.cc: out = in * scale when out_h/out_w unset)."""
+    """Output size resolution matching the reference's precedence
+    (interpolate_op.cc: a positive ``scale`` attr WINS over out_h/out_w)."""
+    scale = attrs.get("scale", 0.0)
+    if scale and scale > 0:
+        return int(h * scale), int(w * scale)
     out_h = int(attrs.get("out_h", 0) or 0)
     out_w = int(attrs.get("out_w", 0) or 0)
-    scale = attrs.get("scale", 0.0)
-    if out_h <= 0:
-        out_h = int(h * scale) if scale else int(h)
-    if out_w <= 0:
-        out_w = int(w * scale) if scale else int(w)
-    return out_h, out_w
+    return (out_h if out_h > 0 else int(h),
+            out_w if out_w > 0 else int(w))
 
 
 @register_op("bilinear_interp", diff_inputs=("X",))

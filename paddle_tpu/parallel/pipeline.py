@@ -1,0 +1,136 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+Net-new capability vs the reference (SURVEY.md section 2.3 row
+"Pipeline/tensor/sequence/context parallelism ... absent in reference").
+TPU-native design: stages live on a ``pipe`` mesh axis; every rank holds
+ONE stage's parameters (a pytree stacked on a leading stage axis, sharded
+``P('pipe')``), and activations hop rank -> rank+1 over ICI with
+``lax.ppermute`` while microbatches stream through — the classic GPipe
+schedule of ``n_micro + n_stages - 1`` ticks with bubble fraction
+``(S-1)/(M+S-1)``. The whole schedule is a ``lax.scan`` inside one
+``shard_map``, so XLA overlaps the per-tick compute with the neighbor
+exchange and the loop compiles once regardless of microbatch count.
+
+The stage body must be shape-preserving (``fn(params_i, x) -> y`` with
+``y.shape == x.shape``) — the transformer's homogeneous layer stack, which
+is what pipeline parallelism is for. Gradients flow through ppermute/scan
+transposes, so ``jax.grad`` (and the Program-IR autodiff that rides on
+it) works through the pipeline unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _gpipe_local(params, x_micro, *, fn: Callable, axis: str,
+                 n_micro: int):
+    """Per-rank body. params: this rank's stage params (leading stage axis
+    already sliced away by shard_map); x_micro: [n_micro, mb, ...]
+    microbatched input (replicated; only rank 0 reads it)."""
+    n_stages = lax.psum(1, axis)
+    rank = lax.axis_index(axis)
+    total = n_micro + n_stages - 1
+    mb_shape = x_micro.shape[1:]
+
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick(carry, t):
+        incoming, out_buf = carry
+        mb_idx = t - rank                       # microbatch this rank runs
+        active = (mb_idx >= 0) & (mb_idx < n_micro)
+        # rank 0 feeds from the input stream; others from the wire
+        feed = lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
+        )
+        x_in = jnp.where(rank == 0, feed, incoming)
+        y = fn(params, x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # last stage banks its result at the microbatch's slot
+        write_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        is_last = rank == n_stages - 1
+        bank = jnp.where(
+            active & is_last, y, jnp.zeros_like(y)
+        )
+        out_buf = lax.dynamic_update_index_in_dim(
+            out_buf,
+            lax.dynamic_index_in_dim(out_buf, write_idx, 0, keepdims=False)
+            + bank,
+            write_idx,
+            axis=0,
+        )
+        # activations hop to the next stage (ring; the wraparound value
+        # into rank 0 is ignored — rank 0 always reads the feed)
+        incoming = lax.ppermute(y, axis, fwd)
+        return (incoming, out_buf), None
+
+    zero = jnp.zeros(mb_shape, x_micro.dtype)
+    out0 = jnp.zeros_like(x_micro)
+    # carries become rank-varying inside the body; align the initial type
+    vary = tuple(jax.typeof(params_leaf).vma | {axis}
+                 for params_leaf in [jax.tree.leaves(params)[0]])[0]
+    zero, out0 = lax.pcast((zero, out0), tuple(vary), to="varying")
+    (_, out), _ = lax.scan(tick, (zero, out0), jnp.arange(total))
+    # only the last rank holds nonzero outputs; psum replicates them
+    return lax.psum(out, axis)
+
+
+def gpipe(
+    fn: Callable,
+    stage_params,
+    x,
+    mesh: Mesh,
+    pipe_axis: str = "pipe",
+    n_micro: Optional[int] = None,
+):
+    """Run ``x`` through ``n_stages`` stages pipelined over ``pipe_axis``.
+
+    - ``fn(params_i, x_mb) -> y_mb`` — one stage's computation, shape
+      preserving.
+    - ``stage_params`` — pytree whose leaves have a leading ``n_stages``
+      axis (sharded onto the pipe axis; each rank holds one slice).
+    - ``x`` — [B, ...] global batch; split into ``n_micro`` microbatches
+      (default: one per stage).
+    Returns [B, ...] outputs (replicated over the pipe axis).
+    """
+    n_stages = mesh.shape[pipe_axis]
+    b = x.shape[0]
+    n_micro = n_micro or n_stages
+    if b % n_micro != 0:
+        raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    x_m = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+    param_specs = jax.tree.map(
+        lambda p: P(pipe_axis, *([None] * (p.ndim - 1))), stage_params
+    )
+
+    def local(params, x_micro):
+        # shard_map slices the stage axis to length 1; drop it
+        params = jax.tree.map(lambda p: p[0], params)
+        return _gpipe_local(
+            params, x_micro, fn=fn, axis=pipe_axis, n_micro=n_micro
+        )
+
+    out = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+    )(stage_params, x_m)
+    return out.reshape((b,) + x.shape[1:])
+
+
+def sequential_reference(fn, stage_params, x):
+    """Same computation without the pipeline (for parity tests)."""
+    n_stages = jax.tree.leaves(stage_params)[0].shape[0]
+    y = x
+    for i in range(n_stages):
+        params_i = jax.tree.map(lambda p: p[i], stage_params)
+        y = fn(params_i, y)
+    return y

@@ -1,0 +1,73 @@
+"""Predictor API tests (reference: inference/api/analysis_predictor.cc,
+api demos using create_paddle_predictor)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import inference, io, layers
+
+
+@pytest.fixture()
+def saved_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[16], dtype="float32")
+        h = layers.fc(x, 32, act="relu",
+                      param_attr=fluid.ParamAttr(name="p1.w"),
+                      bias_attr=fluid.ParamAttr(name="p1.b"))
+        logits = layers.fc(h, 4,
+                           param_attr=fluid.ParamAttr(name="p2.w"),
+                           bias_attr=fluid.ParamAttr(name="p2.b"))
+        probs = layers.softmax(logits)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    d = str(tmp_path / "model")
+    xv = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[probs])
+        io.save_inference_model(d, ["x"], [probs], exe, main)
+    return d, xv, ref
+
+
+def test_predictor_matches_direct_run(saved_model):
+    d, xv, ref = saved_model
+    pred = inference.create_predictor(inference.Config(d).disable_tpu())
+    assert pred.get_input_names() == ["x"]
+    assert len(pred.get_output_names()) == 1
+    (out,) = pred.run([xv])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # dict-keyed feeds too
+    (out2,) = pred.run({"x": xv})
+    np.testing.assert_allclose(out2, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_shape_polymorphism(saved_model):
+    """Each new batch shape compiles once and caches (the executor cache
+    replaces the reference's per-shape TRT engine rebuild)."""
+    d, xv, _ = saved_model
+    pred = inference.create_predictor(inference.Config(d).disable_tpu())
+    for b in (1, 3, 8):
+        (out,) = pred.run([xv[:b]])
+        assert out.shape == (b, 4)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_predictor_input_validation(saved_model):
+    d, xv, _ = saved_model
+    pred = inference.create_predictor(inference.Config(d).disable_tpu())
+    with pytest.raises(ValueError, match="expected 1 inputs"):
+        pred.run([xv, xv])
+    with pytest.raises(KeyError, match="missing"):
+        pred.run({"not_x": xv})
+
+
+def test_predictor_isolated_scopes(saved_model):
+    """Two predictors don't share state (reference: per-predictor scope)."""
+    d, xv, ref = saved_model
+    p1 = inference.create_predictor(inference.Config(d).disable_tpu())
+    p2 = inference.create_predictor(inference.Config(d).disable_tpu())
+    p2.scope.set("p1.w", np.zeros_like(p2.scope.find_var("p1.w")))
+    (out1,) = p1.run([xv])
+    np.testing.assert_allclose(out1, ref, rtol=1e-5, atol=1e-6)
