@@ -299,3 +299,17 @@ def _cos_sim(ins, attrs):
         xn * yn, 1e-12
     )
     return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("fake_quantize_dequantize", diff_inputs=("X",))
+def _fake_quantize_dequantize(ins, attrs):
+    """Simulated symmetric quantization with a straight-through estimator
+    (reference: operators/fake_quantize_op.cc, abs-max variant). The STE
+    is baked into the expression — ``x + sg(q(x) - x)`` — so the auto
+    vjp gives identity gradients inside the clip range."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bits", 8))
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax) * scale / qmax
+    return {"Out": [x + jax.lax.stop_gradient(q - x)]}

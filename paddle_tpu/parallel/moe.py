@@ -1,0 +1,151 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Net-new capability vs the reference (SURVEY.md section 2.3 row
+"Pipeline/tensor/.../EP, MoE — absent in reference"). TPU-native design:
+
+- experts shard one-per-rank over an ``expert`` mesh axis (stacked expert
+  weights with leading axis E, sharded ``P('expert')``);
+- top-k gating runs replicated; tokens route to their expert with
+  ``lax.all_to_all`` over ICI (the TPU analog of the pserver
+  prefetch-by-id the reference used for its only form of sparse model
+  parallelism) — each rank sends every other rank the tokens destined for
+  its expert and gets its own expert's tokens back;
+- capacity-factor truncation keeps shapes static (XLA discipline):
+  each expert processes at most ``capacity`` tokens per source rank;
+  overflow tokens bypass the experts (identity path), the standard
+  GShard/Switch treatment.
+
+Everything (gate, dispatch, expert FFN, combine) lives inside one
+``shard_map``, so XLA overlaps the all_to_all with expert compute.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _moe_local(gate_w, expert_params, x, *, fn: Callable, axis: str,
+               capacity: int):
+    """Per-rank body. x: [n_loc, d] this rank's tokens (batch-sharded);
+    gate_w: [d, E] replicated; expert_params: this rank's expert (leading
+    axis sliced to 1 by shard_map)."""
+    e = lax.psum(1, axis)
+    n_loc, d = x.shape
+
+    # --- top-1 gating (Switch-style), computed on local tokens ---
+    logits = x @ gate_w                          # [n_loc, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)      # [n_loc]
+    gate_val = jnp.max(probs, axis=-1)           # [n_loc]
+
+    # --- build fixed-capacity dispatch buffers per destination expert ---
+    # position of each token within its expert's capacity window
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)   # [n_loc, E]
+    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot       # 1-based
+    pos = jnp.sum(pos_in_expert, axis=-1) - 1                 # [n_loc]
+    keep = (pos >= 0) & (pos < capacity)
+
+    # dispatch[e_dst, cap, d]: tokens this rank sends to each expert
+    dispatch = jnp.zeros((e, capacity, d), x.dtype)
+    dst = jnp.where(keep, expert_idx, e - 1)
+    slot = jnp.clip(pos, 0, capacity - 1)
+    contrib = jnp.where(keep[:, None], x, 0.0)
+    dispatch = dispatch.at[dst, slot].add(contrib)
+
+    # --- all_to_all: axis of experts <-> axis of source ranks ---
+    # after the exchange, this rank holds [src_rank, cap, d] tokens for
+    # ITS expert
+    received = lax.all_to_all(
+        dispatch, axis, split_axis=0, concat_axis=0, tiled=True
+    )                                             # [e*cap... actually [E, cap, d] with E=src ranks
+
+    # --- expert computation on [e*capacity, d] ---
+    flat = received.reshape(e * capacity, d)
+    out = fn(expert_params, flat).reshape(e, capacity, d)
+
+    # --- return trip + combine ---
+    returned = lax.all_to_all(
+        out, axis, split_axis=0, concat_axis=0, tiled=True
+    )                                             # [E, cap, d] per dst expert
+    gathered = returned[dst, slot]                # [n_loc, d]
+    combined = jnp.where(
+        keep[:, None], gathered * gate_val[:, None], x
+    )  # overflow tokens take the identity path
+
+    # auxiliary load-balancing loss (Switch: E * sum(frac_tokens * frac_prob))
+    frac_tokens = jnp.mean(onehot.astype(x.dtype), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(lax.pmean(frac_tokens, axis) *
+                      lax.pmean(frac_probs, axis))
+    return combined, aux
+
+
+def moe_ffn(
+    x,
+    gate_w,
+    expert_params,
+    fn: Callable,
+    mesh: Mesh,
+    expert_axis: str = "expert",
+    data_axis: Optional[str] = None,
+    capacity_factor: float = 2.0,
+):
+    """Expert-parallel MoE layer.
+
+    - ``x`` [n, d] tokens (sharded over ``data_axis`` when given);
+    - ``gate_w`` [d, E] router weights (replicated);
+    - ``expert_params`` pytree with leading expert axis E == mesh size of
+      ``expert_axis`` (each rank keeps one expert);
+    - ``fn(params_i, tokens) -> tokens`` the per-expert computation.
+    Returns (combined [n, d], aux_loss scalar).
+    """
+    e = mesh.shape[expert_axis]
+    n = x.shape[0]
+    n_ranks = mesh.shape.get(data_axis, 1) if data_axis else 1
+    n_loc = n // max(n_ranks, 1)
+    capacity = max(1, int(capacity_factor * n_loc / e))
+
+    param_specs = jax.tree.map(
+        lambda p: P(expert_axis, *([None] * (p.ndim - 1))), expert_params
+    )
+
+    def local(gw, params, xs):
+        params = jax.tree.map(lambda p: p[0], params)
+        return _moe_local(
+            gw, params, xs, fn=fn, axis=expert_axis, capacity=capacity
+        )
+
+    out, aux = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), param_specs, P(data_axis)),
+        out_specs=(P(data_axis), P()),
+        # combined/aux are value-replicated over the expert axis by
+        # construction (x and gate_w are replicated there, and every rank
+        # receives every expert's outputs back), but the varying-axis type
+        # system cannot see through all_to_all — skip the static check.
+        check_vma=False,
+    )(gate_w, expert_params, x)
+    return out, aux
+
+
+def moe_reference(x, gate_w, expert_params, fn):
+    """Dense reference (every token through its argmax expert, no
+    capacity truncation) for parity tests."""
+    e = jax.tree.leaves(expert_params)[0].shape[0]
+    probs = jax.nn.softmax(x @ gate_w, axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.max(probs, axis=-1)
+    outs = []
+    for i in range(e):
+        params_i = jax.tree.map(lambda p: p[i], expert_params)
+        outs.append(fn(params_i, x))
+    stacked = jnp.stack(outs, axis=0)            # [E, n, d]
+    sel = stacked[idx, jnp.arange(x.shape[0])]
+    return sel * gate[:, None]

@@ -170,4 +170,75 @@ def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
     return data_reader
 
 
-multiprocess_reader = xmap_readers  # thread-based stand-in (no fork on TPU hosts)
+def multiprocess_reader(readers, use_pipe: bool = True,
+                        queue_size: int = 1000):
+    """Run each reader in its own OS process, interleaving their samples
+    (reference: decorator.py multiprocess_reader — fork + pipe/queue).
+
+    Worker processes only iterate their reader and enqueue samples, so
+    they never touch the TPU runtime (forking after accelerator init is
+    the thing to avoid; plain data readers are safe). Samples must be
+    picklable. ``use_pipe`` is accepted for API parity; both modes use a
+    multiprocessing queue here.
+
+    Messages are tagged tuples so any sample payload works; a worker
+    exception is re-raised in the consumer (truncated silent epochs are
+    the reference's failure mode too — it forwards an error sentinel);
+    a worker killed without cleanup (OOM/SIGKILL) is detected by a
+    liveness poll instead of hanging the training loop.
+    """
+    if not isinstance(readers, (list, tuple)) or not readers:
+        raise ValueError("multiprocess_reader needs a non-empty reader list")
+
+    def data_reader():
+        import multiprocessing as mp
+        import queue as _queue
+
+        ctx = mp.get_context("fork")
+        q = ctx.Queue(queue_size)
+
+        def worker(r):
+            try:
+                for sample in r():
+                    q.put(("data", sample))
+                q.put(("end", None))
+            except BaseException as e:  # propagated to the consumer
+                q.put(("error", repr(e)))
+
+        procs = [
+            ctx.Process(target=worker, args=(r,), daemon=True)
+            for r in readers
+        ]
+        for p in procs:
+            p.start()
+        ended = 0
+        try:
+            while ended < len(readers):
+                try:
+                    tag, payload = q.get(timeout=5.0)
+                except _queue.Empty:
+                    if not any(p.is_alive() for p in procs):
+                        raise RuntimeError(
+                            "multiprocess_reader: worker process died "
+                            "without an end/error message (killed?)"
+                        )
+                    continue
+                if tag == "end":
+                    ended += 1
+                elif tag == "error":
+                    raise RuntimeError(
+                        f"multiprocess_reader worker failed: {payload}"
+                    )
+                else:
+                    yield payload
+        finally:
+            # early exit leaves workers blocked in q.put on the bounded
+            # queue; terminate first so join doesn't stall per worker
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+
+    return data_reader
+

@@ -1,0 +1,8 @@
+"""Model compression (reference: python/paddle/fluid/contrib/slim/)."""
+
+from paddle_tpu.slim.distill import soft_label_distill_loss  # noqa: F401
+from paddle_tpu.slim.quantization import (  # noqa: F401
+    QuantizationTransformPass,
+    dequantize_weights,
+    quantize_weights_int8,
+)

@@ -1,0 +1,123 @@
+"""Expert-parallel MoE tests on the virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from paddle_tpu.parallel import moe
+
+RS = np.random.RandomState
+
+
+def _expert_fn(params, x):
+    return jnp.tanh(x @ params["w1"]) @ params["w2"]
+
+
+def _setup(e, d, dh, seed=0):
+    r = RS(seed)
+    gate_w = jnp.asarray(r.normal(0, 1.0, (d, e)), jnp.float32)
+    params = {
+        "w1": jnp.asarray(r.normal(0, 0.3, (e, d, dh)), jnp.float32),
+        "w2": jnp.asarray(r.normal(0, 0.3, (e, dh, d)), jnp.float32),
+    }
+    return gate_w, params
+
+
+def test_moe_matches_dense_reference_full_capacity():
+    e, d, dh, n = 4, 8, 16, 32
+    mesh = Mesh(np.asarray(jax.devices()[:e]), ("expert",))
+    gate_w, params = _setup(e, d, dh)
+    x = jnp.asarray(RS(1).normal(0, 1, (n, d)), jnp.float32)
+
+    ref = moe.moe_reference(x, gate_w, params, _expert_fn)
+    # capacity_factor = e makes capacity = n, so nothing truncates
+    got, aux = moe.moe_ffn(x, gate_w, params, _expert_fn, mesh,
+                           capacity_factor=float(e))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    assert float(aux) > 0.0  # load-balance loss is positive
+
+
+def test_moe_dp_x_ep_mesh():
+    """Tokens sharded over data axis, experts over expert axis."""
+    e, d, dh, n = 4, 8, 16, 32
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("data", "expert"))
+    gate_w, params = _setup(e, d, dh, seed=2)
+    x = jnp.asarray(RS(3).normal(0, 1, (n, d)), jnp.float32)
+
+    ref = moe.moe_reference(x, gate_w, params, _expert_fn)
+    got, _ = moe.moe_ffn(x, gate_w, params, _expert_fn, mesh,
+                         data_axis="data", capacity_factor=float(e))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_capacity_overflow_identity_path():
+    """With capacity 0-ish, overflow tokens must pass through unchanged
+    (GShard/Switch overflow handling), not crash or zero out."""
+    e, d, dh, n = 4, 8, 16, 16
+    mesh = Mesh(np.asarray(jax.devices()[:e]), ("expert",))
+    _, params = _setup(e, d, dh, seed=4)
+    # zero gate -> uniform logits -> argmax ties break to expert 0 for
+    # every token: deterministic all-to-one routing
+    gate_w = jnp.zeros((d, e), jnp.float32)
+    x = jnp.asarray(RS(5).normal(0, 1, (n, d)), jnp.float32)
+    got, _ = moe.moe_ffn(x, gate_w, params, _expert_fn, mesh,
+                         capacity_factor=0.5)
+    # capacity = 0.5 * 16 / 4 = 2 tokens; the other 14 take identity
+    changed = np.abs(np.asarray(got) - np.asarray(x)).sum(axis=-1) > 1e-6
+    assert changed.sum() == 2, changed.sum()
+
+
+def test_moe_gradients_flow():
+    e, d, dh, n = 4, 8, 8, 16
+    mesh = Mesh(np.asarray(jax.devices()[:e]), ("expert",))
+    gate_w, params = _setup(e, d, dh, seed=6)
+    x = jnp.asarray(RS(7).normal(0, 1, (n, d)), jnp.float32)
+
+    def loss(params, gw):
+        out, aux = moe.moe_ffn(x, gw, params, _expert_fn, mesh,
+                               capacity_factor=float(e))
+        return jnp.mean(out ** 2) + 0.01 * aux
+
+    grads, ggate = jax.grad(loss, argnums=(0, 1))(params, gate_w)
+    for k, g in grads.items():
+        g = np.asarray(g)
+        assert np.isfinite(g).all() and np.abs(g).max() > 0, k
+    assert np.isfinite(np.asarray(ggate)).all()
+    assert np.abs(np.asarray(ggate)).max() > 0  # router learns too
+
+
+def test_moe_trains_to_specialize():
+    """End-to-end: a 2-expert MoE learns a task where the two halves of
+    the input space need different linear maps."""
+    e, d, dh, n = 2, 4, 8, 64
+    mesh = Mesh(np.asarray(jax.devices()[:e]), ("expert",))
+    gate_w, params = _setup(e, d, dh, seed=8)
+    r = RS(9)
+    x = jnp.asarray(r.normal(0, 1, (n, d)), jnp.float32)
+    # targets: sign of first feature decides the transform
+    t = jnp.where(x[:, :1] > 0, x * 2.0, -x)
+
+    def loss(state):
+        out, aux = moe.moe_ffn(x, state["gate"], state["params"],
+                               _expert_fn, mesh, capacity_factor=float(e))
+        return jnp.mean((out - t) ** 2) + 0.01 * aux
+
+    state = {"gate": gate_w, "params": params}
+    lr = 0.1
+    l0 = float(loss(state))
+    g = jax.jit(jax.grad(loss))
+    for _ in range(200):
+        grads = g(state)
+        state = jax.tree.map(lambda p, gr: p - lr * gr, state, grads)
+    l1 = float(loss(state))
+    # top-1 hard routing limits how far SGD specializes on this toy task;
+    # halving the loss shows the experts + router genuinely train
+    assert l1 < l0 * 0.55, (l0, l1)
+    # both experts get traffic after training (no collapse)
+    probs = jax.nn.softmax(x @ state["gate"], axis=-1)
+    counts = np.bincount(np.asarray(jnp.argmax(probs, -1)), minlength=e)
+    assert (counts > 0).all(), counts

@@ -491,3 +491,56 @@ def test_interp_scale_attr():
     out = np.asarray(get_op_def("nearest_interp").compute(
         {"X": [x]}, {"scale": 2.0, "align_corners": False})["Out"][0])
     np.testing.assert_allclose(out, x.repeat(2, 2).repeat(2, 3))
+
+
+def test_multiprocess_reader_interleaves_all_samples():
+    """reference: decorator.py multiprocess_reader — one process per
+    reader, all samples delivered."""
+    from paddle_tpu.reader import decorator
+
+    def make(lo, hi):
+        def r():
+            for i in range(lo, hi):
+                yield (i, np.arange(3) + i)
+        return r
+
+    mr = decorator.multiprocess_reader([make(0, 20), make(100, 120)])
+    got = sorted(s[0] for s in mr())
+    assert got == list(range(0, 20)) + list(range(100, 120))
+
+    with pytest.raises(ValueError):
+        decorator.multiprocess_reader([])
+
+
+def test_multiprocess_reader_ndarray_samples_and_errors():
+    """Bare ndarray samples work, worker exceptions surface, and early
+    exit doesn't stall (code-review findings, round 2)."""
+    import time
+
+    from paddle_tpu.reader import decorator
+
+    def arr_reader():
+        for i in range(5):
+            yield np.arange(3) + i  # bare ndarray payload
+
+    got = list(decorator.multiprocess_reader([arr_reader])())
+    assert len(got) == 5
+
+    def bad_reader():
+        yield np.zeros(2)
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="worker failed"):
+        list(decorator.multiprocess_reader([bad_reader])())
+
+    def big_reader():
+        for i in range(100000):
+            yield np.zeros(16)
+
+    t0 = time.time()
+    it = decorator.multiprocess_reader([big_reader, big_reader],
+                                       queue_size=8)()
+    for _, _s in zip(range(3), it):
+        pass
+    it.close()  # early exit must terminate workers promptly
+    assert time.time() - t0 < 5.0

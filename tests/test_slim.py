@@ -1,0 +1,121 @@
+"""Slim compression tests: QAT pass, PTQ int8 export, distillation
+(reference: contrib/slim/quantization/quantization_pass.py,
+slim/distillation/distiller.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, slim
+from tests.op_test import OpHarness
+
+RS = np.random.RandomState
+
+
+def test_fake_quant_op_values_and_ste_grad():
+    x = RS(0).randn(4, 5) * 3
+    h = OpHarness("fake_quantize_dequantize", {"X": x}, attrs={"bits": 8})
+    scale = np.abs(x).max()
+    q = np.clip(np.round(x / scale * 127), -127, 127) * scale / 127
+    h.check_output({"Out": q}, atol=1e-6)
+    # quantization error is bounded by half a step
+    assert np.abs(q - x).max() <= scale / 127
+
+
+def _mlp(quant=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[16], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, 32, act="relu",
+                      param_attr=fluid.ParamAttr(name="q1.w"),
+                      bias_attr=fluid.ParamAttr(name="q1.b"))
+        logits = layers.fc(h, 4,
+                           param_attr=fluid.ParamAttr(name="q2.w"),
+                           bias_attr=fluid.ParamAttr(name="q2.b"))
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        n_q = 0
+        if quant:
+            n_q = slim.QuantizationTransformPass().apply(main)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    return main, startup, loss, logits, n_q
+
+
+def _batches(n=30):
+    rng = RS(3)
+    probe = RS(5).randn(16, 4)
+    out = []
+    for _ in range(n):
+        x = rng.randn(32, 16).astype(np.float32)
+        y = np.argmax(x @ probe, 1).astype(np.int64)[:, None]
+        out.append({"x": x, "label": y})
+    return out
+
+
+def test_qat_pass_inserts_and_trains():
+    main, startup, loss, logits, n_q = _mlp(quant=True)
+    # 2 fc layers x (activation + weight) = 4 fake-quant sites
+    assert n_q == 4
+    assert sum(1 for op in main.global_block().ops
+               if op.type == "fake_quantize_dequantize") == 4
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for fd in _batches():
+            losses.append(float(
+                exe.run(main, feed=fd, fetch_list=[loss])[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7  # trains THROUGH the fake quant
+
+
+def test_ptq_int8_roundtrip_close():
+    main, startup, loss, logits, _ = _mlp(quant=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fd = _batches(1)[0]
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for b in _batches(10):
+            exe.run(main, feed=b, fetch_list=[loss])
+        (ref,) = exe.run(main, feed=fd, fetch_list=[logits])
+        packed = slim.quantize_weights_int8(main, scope)
+    assert set(packed) == {"q1.w", "q1.b", "q2.w", "q2.b"}
+    assert all(q.dtype == np.int8 for q, _ in packed.values())
+
+    scope2 = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope2):
+        exe2.run(startup)  # fresh (wrong) init
+        slim.dequantize_weights(packed, scope2)
+        (got,) = exe2.run(main, feed=fd, fetch_list=[logits])
+    # int8 round-trip keeps logits close and rankings identical
+    assert np.abs(got - ref).max() < 0.25  # per-tensor int8 noise
+    assert (np.argmax(got, -1) == np.argmax(ref, -1)).mean() > 0.95
+
+
+def test_distillation_loss_trains_student_toward_teacher():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        t_logits = layers.data("t_logits", shape=[4], dtype="float32")
+        s_logits = layers.fc(x, 4,
+                             param_attr=fluid.ParamAttr(name="s.w"),
+                             bias_attr=fluid.ParamAttr(name="s.b"))
+        dloss = slim.soft_label_distill_loss(s_logits, t_logits,
+                                             temperature=2.0)
+        fluid.optimizer.Adam(1e-2).minimize(dloss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = RS(0)
+    teacher_w = RS(1).randn(8, 4).astype(np.float32)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(250):
+            xv = rng.randn(32, 8).astype(np.float32)
+            fd = {"x": xv, "t_logits": (xv @ teacher_w)}
+            losses.append(float(
+                exe.run(main, feed=fd, fetch_list=[dloss])[0]))
+    assert losses[-1] < losses[0] * 0.35  # student matches teacher dist
