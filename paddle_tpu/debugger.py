@@ -1,0 +1,72 @@
+"""Program inspection: pretty printer + graphviz export
+(reference: python/paddle/fluid/debugger.py — draw_block_graphviz /
+pprint_program_codes)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.framework import Program
+
+
+def pprint_program(program: Program, with_shapes: bool = True) -> str:
+    """Readable multi-block listing of a Program's vars and ops."""
+    lines = []
+    for block in program.blocks:
+        lines.append(f"block {block.idx}:")
+        for name, var in sorted(block.vars.items()):
+            shape = f" shape={list(var.shape)}" if (
+                with_shapes and var.shape is not None) else ""
+            tags = "".join(
+                t for t, on in ((" param", var.is_parameter),
+                                (" persistable", var.persistable),
+                                (" stop_grad", var.stop_gradient)) if on
+            )
+            lines.append(f"  var {name}{shape}{tags}")
+        for i, op in enumerate(block.ops):
+            ins = ", ".join(
+                f"{k}={v}" for k, v in op.inputs.items() if v)
+            outs = ", ".join(
+                f"{k}={v}" for k, v in op.outputs.items() if v)
+            lines.append(f"  [{i}] {op.type}({ins}) -> {outs}")
+    return "\n".join(lines)
+
+
+def draw_block_graphviz(program: Program, block_idx: int = 0,
+                        path: Optional[str] = None,
+                        highlights: Optional[set] = None) -> str:
+    """Graphviz dot source for one block's dataflow: op nodes (boxes)
+    connected through var nodes (ellipses). Write to ``path`` if given."""
+    block = program.blocks[block_idx]
+    highlights = highlights or set()
+    lines = ["digraph G {", "  rankdir=TB;"]
+    # sequential ids: deterministic across runs and collision-free
+    var_ids: dict = {}
+
+    def var_node(name):
+        if name not in var_ids:
+            var_ids[name] = f"var_{len(var_ids)}"
+            color = ' style=filled fillcolor="#ffd27f"' \
+                if name in highlights else ""
+            lines.append(
+                f'  {var_ids[name]} [label="{name}" shape=ellipse{color}];')
+        return var_ids[name]
+
+    for i, op in enumerate(block.ops):
+        op_id = f"op_{i}"
+        lines.append(
+            f'  {op_id} [label="{op.type}" shape=box '
+            f'style=filled fillcolor="#cfe2ff"];'
+        )
+        for n in op.input_arg_names:
+            if n:
+                lines.append(f"  {var_node(n)} -> {op_id};")
+        for n in op.output_arg_names:
+            if n:
+                lines.append(f"  {op_id} -> {var_node(n)};")
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

@@ -1,0 +1,94 @@
+"""Inference transpiler (conv+BN fold) + debugger tests
+(reference: transpiler/inference_transpiler.py, fluid/debugger.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import debugger, layers
+from paddle_tpu.transpiler import InferenceTranspiler
+
+
+def _conv_bn_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3, 8, 8], dtype="float32")
+        c = layers.conv2d(x, 6, 3, padding=1, bias_attr=False,
+                          param_attr=fluid.ParamAttr(name="cv.w"))
+        b = layers.batch_norm(c, is_test=False,
+                              param_attr=fluid.ParamAttr(name="bn.s"),
+                              bias_attr=fluid.ParamAttr(name="bn.b"))
+        out = layers.relu(b)
+        test_prog = main.clone(for_test=True)
+    return main, startup, test_prog, out
+
+
+def test_bn_fold_preserves_outputs():
+    main, startup, test_prog, out = _conv_bn_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xv = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # a few train steps so BN stats are non-trivial
+        for _ in range(3):
+            exe.run(main, feed={"x": xv}, fetch_list=[out])
+        (ref,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+
+        n = InferenceTranspiler().transpile(test_prog, scope)
+        assert n == 1
+        types = [op.type for op in test_prog.global_block().ops]
+        assert "batch_norm" not in types
+        (got,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_bn_fold_skips_shared_conv_output():
+    """A conv whose output feeds anything besides the BN must not fold."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3, 8, 8], dtype="float32")
+        c = layers.conv2d(x, 4, 3, padding=1, bias_attr=False)
+        b = layers.batch_norm(c, is_test=True)
+        both = layers.elementwise_add(b, c)  # second consumer of c
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        assert InferenceTranspiler().transpile(main, scope) == 0
+
+
+def test_debugger_pprint_and_dot(tmp_path):
+    main, startup, test_prog, out = _conv_bn_model()
+    text = debugger.pprint_program(main)
+    assert "conv2d" in text and "batch_norm" in text and "var" in text
+    dot = debugger.draw_block_graphviz(
+        main, path=str(tmp_path / "g.dot"), highlights={"cv.w"})
+    assert dot.startswith("digraph") and "conv2d" in dot
+    assert (tmp_path / "g.dot").exists()
+
+
+def test_bn_fold_drops_stats_from_saved_artifact(tmp_path):
+    """Folded BN statistics must not be serialized (code-review finding,
+    round 2)."""
+    from paddle_tpu import io
+
+    main, startup, test_prog, out = _conv_bn_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xv = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": xv}, fetch_list=[out])
+        InferenceTranspiler().transpile(test_prog, scope)
+        io.save_inference_model(str(tmp_path / "m"), ["x"], [out], exe,
+                                test_prog)
+    saved = np.load(str(tmp_path / "m" / "__params__.npz"))
+    assert not any(n.startswith("bn.") for n in saved.files), saved.files
+
+
+def test_dot_ids_deterministic():
+    main, _, _, _ = _conv_bn_model()
+    a = debugger.draw_block_graphviz(main)
+    b = debugger.draw_block_graphviz(main)
+    assert a == b
+    assert "var_0 " in a  # sequential ids
