@@ -27,7 +27,14 @@ from paddle_tpu.compiler import (  # noqa: F401
     CompiledProgram,
     ExecutionStrategy,
 )
-from paddle_tpu import inference  # noqa: F401
+from paddle_tpu import (  # noqa: F401
+    dataset_api,
+    debugger,
+    inference,
+    install_check,
+    transpiler,
+)
+from paddle_tpu.dataset_api import DatasetFactory  # noqa: F401
 from paddle_tpu.executor import (  # noqa: F401
     Executor,
     Scope,

@@ -1,0 +1,59 @@
+"""Installation sanity check (reference: python/paddle/fluid/install_check.py
+``run_check`` — builds a tiny model, runs one train step, reports).
+
+``run_check()`` trains a 2-layer MLP for a few steps on the current
+default device (TPU when present, else CPU) and verifies the loss is
+finite and decreasing; it also reports the visible devices and whether
+the native C++ runtime library is loadable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def run_check(verbose: bool = True) -> bool:
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, native
+
+    def log(*a):
+        if verbose:
+            print(*a)
+
+    log(f"paddle_tpu running on backend '{jax.default_backend()}' "
+        f"with devices {jax.devices()}")
+    log(f"native C++ runtime available: {native.available()}")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(x, 16, act="relu")
+        logits = layers.fc(h, 4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    probe = np.random.RandomState(1).randn(8, 4)
+    from paddle_tpu.executor import scope_guard
+
+    losses = []
+    with scope_guard(scope):
+        exe.run(startup)
+        for _ in range(10):
+            xv = rng.randn(32, 8).astype(np.float32)
+            yv = np.argmax(xv @ probe, 1).astype(np.int64)[:, None]
+            out = exe.run(main, feed={"x": xv, "label": yv},
+                          fetch_list=[loss])
+            losses.append(float(out[0]))
+    ok = bool(np.isfinite(losses).all() and losses[-1] < losses[0])
+    if ok:
+        log("paddle_tpu is installed successfully! loss "
+            f"{losses[0]:.4f} -> {losses[-1]:.4f}")
+    else:
+        log(f"paddle_tpu check FAILED: losses {losses}")
+    return ok

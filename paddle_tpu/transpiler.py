@@ -58,6 +58,9 @@ class InferenceTranspiler:
                 continue
 
             w_name = p_op.inputs["Filter"][0]
+            # a filter shared by other ops cannot be folded in place
+            if len(consumers.get(w_name, [])) > 1:
+                continue
             w = np.asarray(scope.find_var(w_name))
             scale = np.asarray(scope.find_var(op.inputs["Scale"][0]))
             shift = np.asarray(scope.find_var(op.inputs["Bias"][0]))
@@ -88,9 +91,11 @@ class InferenceTranspiler:
             block.ops[idx] = add  # replaces the batch_norm in place
             # the BN statistics are dead now — drop their persistable
             # vars so save_persistables/save_inference_model skip them
+            # (unless another, unfolded op still consumes them)
             for slot in ("Scale", "Bias", "Mean", "Variance"):
                 for dead in op.inputs.get(slot, []):
-                    block.vars.pop(dead, None)
+                    if consumers.get(dead, []) == [idx]:
+                        block.vars.pop(dead, None)
             folded += 1
 
         if folded:

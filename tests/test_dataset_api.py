@@ -1,0 +1,83 @@
+"""Dataset API + install_check tests (reference: fluid/dataset.py,
+fluid/install_check.py)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import install_check
+from paddle_tpu.dataset_api import (
+    DatasetFactory,
+    InMemoryDataset,
+    QueueDataset,
+)
+
+
+def _write_files(tmp_path, n_files=3, rows=8):
+    paths = []
+    k = 0
+    for i in range(n_files):
+        p = tmp_path / f"part-{i}.txt"
+        with open(p, "w") as f:
+            for _ in range(rows):
+                f.write(f"{k} {k % 5}\n")
+                k += 1
+        paths.append(str(p))
+    return paths, k
+
+
+def _parse(line):
+    a, b = line.split()
+    return np.array([float(a)], np.float32), np.array([int(b)], np.int64)
+
+
+def test_factory_and_queue_dataset(tmp_path):
+    paths, total = _write_files(tmp_path)
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    assert isinstance(ds, QueueDataset)
+    ds.set_filelist(paths)
+    ds.set_batch_size(4)
+    ds.set_thread(2)
+    ds.set_use_var(["x", "y"])
+    ds.set_parse_fn(_parse)
+    seen = []
+    for batch in ds.batch_reader()():
+        assert set(batch) == {"x", "y"}
+        assert batch["x"].dtype == np.float32
+        seen.extend(batch["x"][:, 0].tolist())
+    assert sorted(int(v) for v in seen) == list(range(total))
+
+
+def test_in_memory_dataset_shuffles(tmp_path):
+    paths, total = _write_files(tmp_path)
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    assert isinstance(ds, InMemoryDataset)
+    ds.set_filelist(paths)
+    ds.set_batch_size(total)
+    ds.set_use_var(["x", "y"])
+    ds.set_parse_fn(_parse)
+    ds.load_into_memory()
+    before = next(iter(ds.batch_reader()()))["x"][:, 0]
+    ds.set_shuffle_seed(7)
+    ds.local_shuffle()
+    after = next(iter(ds.batch_reader()()))["x"][:, 0]
+    assert sorted(before) == sorted(after)
+    assert not np.array_equal(before, after)
+    # global shuffle without a fleet degrades to local shuffle
+    ds.global_shuffle()
+    again = next(iter(ds.batch_reader()()))["x"][:, 0]
+    assert sorted(again) == sorted(before)
+    ds.release_memory()
+
+
+def test_dataset_errors(tmp_path):
+    ds = InMemoryDataset()
+    with pytest.raises(RuntimeError, match="set_parse_fn"):
+        list(ds.batch_reader()())
+    with pytest.raises(RuntimeError, match="load_into_memory"):
+        ds.local_shuffle()
+    with pytest.raises(ValueError, match="unknown dataset"):
+        DatasetFactory().create_dataset("nope")
+
+
+def test_install_check_runs():
+    assert install_check.run_check(verbose=False) is True

@@ -92,3 +92,48 @@ def test_dot_ids_deterministic():
     b = debugger.draw_block_graphviz(main)
     assert a == b
     assert "var_0 " in a  # sequential ids
+
+
+def test_bn_fold_skips_shared_filter():
+    """A conv filter shared by two convs must not fold (code-review
+    finding, round 2)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3, 8, 8], dtype="float32")
+        shared = fluid.ParamAttr(name="shared.w")
+        c1 = layers.conv2d(x, 3, 3, padding=1, bias_attr=False,
+                           param_attr=shared)
+        c2 = layers.conv2d(x, 3, 3, padding=1, bias_attr=False,
+                           param_attr=fluid.ParamAttr(name="shared.w"))
+        b1 = layers.batch_norm(c1, is_test=True)
+        b2 = layers.batch_norm(c2, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        assert InferenceTranspiler().transpile(main, scope) == 0
+
+
+def test_bn_fold_keeps_shared_stats_vars():
+    """Shared BN stats referenced by an unfolded BN must survive in
+    block.vars (code-review finding, round 2)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3, 8, 8], dtype="float32")
+        c1 = layers.conv2d(x, 4, 3, padding=1, bias_attr=False)
+        b1 = layers.batch_norm(c1, is_test=True,
+                               moving_mean_name="shared.mean",
+                               moving_variance_name="shared.var")
+        c2 = layers.conv2d(x, 4, 3, padding=1, bias_attr=False)
+        b2 = layers.batch_norm(c2, is_test=True,
+                               moving_mean_name="shared.mean",
+                               moving_variance_name="shared.var")
+        both = layers.elementwise_add(b2, c2)  # blocks folding of b2
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        assert InferenceTranspiler().transpile(main, scope) == 1
+    # the surviving batch_norm still finds its shared stats vars
+    assert main.global_block()._find_var_recursive("shared.mean") is not None
+    assert main.global_block()._find_var_recursive("shared.var") is not None
