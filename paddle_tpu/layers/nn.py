@@ -32,6 +32,7 @@ __all__ = [
     "flatten", "sums", "elementwise_mod", "elementwise_floordiv", "maxout",
     "mean_iou",
     "linear_chain_crf", "crf_decoding", "warpctc", "edit_distance",
+    "bilinear_tensor_product", "nce",
 ]
 
 
@@ -1143,3 +1144,50 @@ def edit_distance(input, label, normalized=True, input_length=None,
         attrs={"normalized": normalized},
     )
     return out, num
+
+
+def bilinear_tensor_product(x, y, size, act=None, param_attr=None,
+                            bias_attr=None, name=None):
+    """out_k = x^T W_k y (reference: layers/nn.py bilinear_tensor_product)."""
+    helper = LayerHelper("bilinear_tensor_product", name=name, act=act)
+    w = helper.create_parameter(
+        ParamAttr._to_attr(param_attr),
+        shape=[size, x.shape[-1], y.shape[-1]], dtype=x.dtype,
+    )
+    b = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr), shape=[size], dtype=x.dtype,
+        is_bias=True,
+    )
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {"X": x, "Y": y, "Weight": w}
+    if b is not None:
+        inputs["Bias"] = b
+    helper.append_op(
+        "bilinear_tensor_product", inputs=inputs, outputs={"Out": out}
+    )
+    return helper.append_activation(out)
+
+
+def nce(input, label, num_total_classes, num_neg_samples=10,
+        param_attr=None, bias_attr=None, name=None):
+    """Noise-contrastive estimation (reference: layers/nn.py nce).
+    Returns per-example cost [b, 1]; the weight table is [C, D]."""
+    helper = LayerHelper("nce", name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        ParamAttr._to_attr(param_attr),
+        shape=[num_total_classes, d], dtype=input.dtype,
+    )
+    b = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr), shape=[num_total_classes],
+        dtype=input.dtype, is_bias=True,
+    )
+    cost = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"Input": input, "Label": label, "Weight": w}
+    if b is not None:
+        inputs["Bias"] = b
+    helper.append_op(
+        "nce", inputs=inputs, outputs={"Cost": cost},
+        attrs={"num_neg_samples": num_neg_samples},
+    )
+    return cost

@@ -610,3 +610,51 @@ def _auc(ins, attrs):
     fpr = jnp.concatenate([jnp.zeros((1,)), fp / tot_neg])
     auc = jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0)
     return {"AUC": [auc]}
+
+
+@register_op("bilinear_tensor_product",
+             diff_inputs=("X", "Y", "Weight", "Bias"))
+def _bilinear_tensor_product(ins, attrs):
+    """out[b, k] = x[b] @ W[k] @ y[b] + bias[k]
+    (reference: operators/bilinear_tensor_product_op.cc)."""
+    x, y = _x(ins), _x(ins, "Y")
+    w = _x(ins, "Weight")                        # [K, Dx, Dy]
+    bias = _x(ins, "Bias")
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1))
+    return {"Out": [out]}
+
+
+@register_op("nce", diff_inputs=("Input", "Weight", "Bias"), needs_rng=True)
+def _nce(ins, attrs, rng=None):
+    """Noise-contrastive estimation loss (reference: operators/nce_op.cc,
+    uniform sampler). Avoids the full-vocab softmax: per example, score
+    the true class plus ``num_neg_samples`` uniform negatives.
+
+    inputs: Input [B, D], Label [B, 1] int, Weight [C, D], Bias [C] opt.
+    outputs: Cost [B, 1].
+    """
+    x = ins["Input"][0]
+    label = ins["Label"][0]
+    if jnp.ndim(label) > 1:
+        label = jnp.squeeze(label, -1)
+    w = ins["Weight"][0]
+    bias = _x(ins, "Bias")
+    c = jnp.shape(w)[0]
+    k = int(attrs.get("num_neg_samples", 10))
+    b = jnp.shape(x)[0]
+
+    neg = jax.random.randint(rng, (b, k), 0, c)          # uniform sampler
+    ids = jnp.concatenate([label[:, None], neg], axis=1)  # [B, 1+K]
+    w_sel = jnp.take(w, ids, axis=0)                      # [B, 1+K, D]
+    logits = jnp.einsum("bd,bkd->bk", x, w_sel)
+    if bias is not None:
+        logits = logits + jnp.take(bias, ids)
+    # NCE with uniform noise: q = k / C per class
+    log_q = jnp.log(jnp.asarray(k, logits.dtype)) - jnp.log(
+        jnp.asarray(c, logits.dtype))
+    adj = logits - log_q
+    pos = jax.nn.log_sigmoid(adj[:, 0])
+    negs = jnp.sum(jax.nn.log_sigmoid(-adj[:, 1:]), axis=1)
+    return {"Cost": [(-(pos + negs))[:, None]]}

@@ -544,3 +544,47 @@ def test_multiprocess_reader_ndarray_samples_and_errors():
         pass
     it.close()  # early exit must terminate workers promptly
     assert time.time() - t0 < 5.0
+
+
+def test_bilinear_tensor_product_op():
+    x = RS(50).randn(3, 4)
+    y = RS(51).randn(3, 5)
+    w = RS(52).randn(2, 4, 5)
+    b = RS(53).randn(2)
+    h = OpHarness("bilinear_tensor_product",
+                  {"X": x, "Y": y, "Weight": w, "Bias": b})
+    exp = np.einsum("bi,kij,bj->bk", x, w, y) + b[None, :]
+    h.check_output({"Out": exp})
+    h.check_grad(["x_0", "y_0", "weight_0", "bias_0"])
+
+
+def test_nce_layer_trains():
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[16], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        emb = layers.fc(x, 24, act="relu",
+                        param_attr=fluid.ParamAttr(name="nce_h.w"))
+        cost = layers.nce(emb, label, num_total_classes=50,
+                          num_neg_samples=8,
+                          param_attr=fluid.ParamAttr(name="nce.w"))
+        loss = layers.mean(cost)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = RS(0)
+    probe = RS(1).randn(16, 50)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(40):
+            xv = rng.randn(64, 16).astype(np.float32)
+            yv = np.argmax(xv @ probe, 1).astype(np.int64)[:, None]
+            losses.append(float(
+                exe.run(main, feed={"x": xv, "label": yv},
+                        fetch_list=[loss])[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8  # NCE cost decreasing
