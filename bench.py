@@ -76,10 +76,12 @@ def main():
         n_layer=6,
         dropout=0.1,
     )
+    use_scan = os.environ.get("PT_BENCH_SCAN", "0") == "1"
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
-        model = T.build(cfg)
+        model = T.build_scan(cfg) if use_scan else T.build(cfg)
         fluid.optimizer.Adam(1e-4).minimize(model["loss"])
+    log(f"layer mode: {'scan' if use_scan else 'unrolled'}")
     main_prog._amp = True  # bf16 matmuls, f32 master weights
 
     exe = fluid.Executor()

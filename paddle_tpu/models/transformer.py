@@ -21,7 +21,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 import paddle_tpu as fluid
-from paddle_tpu import layers
+from paddle_tpu import layers, unique_name
 from paddle_tpu.param_attr import ParamAttr
 
 
@@ -220,22 +220,17 @@ def decoder_layer(x, enc_out, self_bias, cross_bias, cfg, i, is_test):
     return _pre_post(ff, x, cfg, p, is_test)
 
 
-def build(cfg: Optional[TransformerConfig] = None, is_test: bool = False):
-    """Builds the full training graph in the current main/startup programs.
 
-    Feeds: src_ids[b,s], trg_ids[b,t], lbl_ids[b,t], src_mask[b,1,1,s] (1 =
-    real token), trg_mask is derived causally inside. Returns dict of key
-    variables."""
-    cfg = cfg or base()
+def _train_feeds_and_biases():
+    """Shared feed vars + attention biases for build()/build_scan()."""
+    from paddle_tpu.layer_helper import LayerHelper
+
     src = layers.data("src_ids", shape=[-1], dtype="int64",
                       append_batch_size=True)
     trg = layers.data("trg_ids", shape=[-1], dtype="int64")
     lbl = layers.data("lbl_ids", shape=[-1], dtype="int64")
-    src_pad = layers.data("src_pad_mask", shape=[-1], dtype="float32")  # [b,s] 1=real
-    trg_pad = layers.data("trg_pad_mask", shape=[-1], dtype="float32")  # [b,t]
-
-    from paddle_tpu.layer_helper import LayerHelper
-
+    src_pad = layers.data("src_pad_mask", shape=[-1], dtype="float32")
+    trg_pad = layers.data("trg_pad_mask", shape=[-1], dtype="float32")
     helper = LayerHelper("attn_bias")
     enc_bias = helper.create_variable_for_type_inference("float32", True)
     helper.append_op("attn_bias", inputs={"PadMask": src_pad},
@@ -243,6 +238,44 @@ def build(cfg: Optional[TransformerConfig] = None, is_test: bool = False):
     dec_self_bias = helper.create_variable_for_type_inference("float32", True)
     helper.append_op("attn_bias", inputs={"PadMask": trg_pad},
                      outputs={"Out": dec_self_bias}, attrs={"causal": True})
+    return src, trg, lbl, src_pad, trg_pad, enc_bias, dec_self_bias
+
+
+def _loss_head(dec, lbl, trg_pad, cfg):
+    """Shared projection + (optionally label-smoothed) masked token loss."""
+    logits = layers.fc(
+        dec, cfg.trg_vocab_size, num_flatten_dims=2,
+        param_attr=ParamAttr(name="proj_colp.w"), bias_attr=False,
+    )
+    if cfg.label_smooth_eps:
+        smooth = layers.label_smooth(
+            layers.one_hot(lbl, cfg.trg_vocab_size),
+            epsilon=cfg.label_smooth_eps,
+        )
+        ce = layers.softmax_with_cross_entropy(logits, smooth,
+                                               soft_label=True)
+    else:
+        ce = layers.softmax_with_cross_entropy(
+            logits, layers.unsqueeze(lbl, [2]))
+    ce = layers.reshape(ce, [0, -1])
+    masked = layers.elementwise_mul(ce, trg_pad)
+    token_count = layers.reduce_sum(trg_pad)
+    loss = layers.elementwise_div(
+        layers.reduce_sum(masked), layers.elementwise_max(
+            token_count, layers.fill_constant_like(token_count, 1.0))
+    )
+    return logits, token_count, loss
+
+
+def build(cfg: Optional[TransformerConfig] = None, is_test: bool = False):
+    """Builds the full training graph in the current main/startup programs.
+
+    Feeds: src_ids[b,s], trg_ids[b,t], lbl_ids[b,t], src_mask[b,1,1,s] (1 =
+    real token), trg_mask is derived causally inside. Returns dict of key
+    variables."""
+    cfg = cfg or base()
+    (src, trg, lbl, src_pad, trg_pad,
+     enc_bias, dec_self_bias) = _train_feeds_and_biases()
     cross_bias = enc_bias  # same src padding bias, broadcast over query dim
 
     enc = _embed(src, cfg.src_vocab_size, cfg, "src_emb.w", "src_pos.w", is_test)
@@ -255,29 +288,7 @@ def build(cfg: Optional[TransformerConfig] = None, is_test: bool = False):
         dec = decoder_layer(dec, enc, dec_self_bias, cross_bias, cfg, i, is_test)
     dec = _ln(dec, "dec_post")
 
-    logits = layers.fc(
-        dec, cfg.trg_vocab_size, num_flatten_dims=2,
-        param_attr=ParamAttr(name="proj_colp.w"), bias_attr=False,
-    )
-
-    if cfg.label_smooth_eps:
-        smooth = layers.label_smooth(
-            layers.one_hot(lbl, cfg.trg_vocab_size),
-            epsilon=cfg.label_smooth_eps,
-        )
-        ce = layers.softmax_with_cross_entropy(logits, smooth, soft_label=True)
-    else:
-        ce = layers.softmax_with_cross_entropy(
-            logits, layers.unsqueeze(lbl, [2])
-        )
-    # [b, t, 1] -> [b, t]; mask padding, normalize by real token count
-    ce = layers.reshape(ce, [0, -1])
-    masked = layers.elementwise_mul(ce, trg_pad)
-    token_count = layers.reduce_sum(trg_pad)
-    loss = layers.elementwise_div(
-        layers.reduce_sum(masked), layers.elementwise_max(
-            token_count, layers.fill_constant_like(token_count, 1.0))
-    )
+    logits, token_count, loss = _loss_head(dec, lbl, trg_pad, cfg)
     return {
         "feeds": [src, trg, lbl, src_pad, trg_pad],
         "loss": loss,
@@ -480,3 +491,321 @@ def translate(exe, scope, src_ids: np.ndarray, src_pad: np.ndarray,
             fetch_list=[dec["ids"], dec["scores"]],
         )
     return ids, scores
+
+
+# --- scan-over-layers build (compile-time optimization) ---
+#
+# The per-layer build unrolls n_layer copies of the same subgraph, so
+# trace size and XLA compile time grow linearly (superlinearly after
+# fusion) with depth. This variant stacks each weight kind across layers
+# ([L, ...] parameters) and runs ONE `scan` op whose sub-block is a single
+# layer: the program, the trace, and the HLO are O(1) in depth, and the
+# scan grad is XLA's scan transpose. Same math as build() — a parity test
+# maps per-layer weights onto the stacks and checks losses match.
+
+
+def _w_fc(x, w, b=None, act=None):
+    """fc with EXPLICIT weight vars (no parameter creation) — for scan
+    sub-blocks where weights are per-layer slices."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("wfc")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        "mul", inputs={"X": x, "Y": w}, outputs={"Out": out},
+        attrs={"x_num_col_dims": 2, "y_num_col_dims": 1},
+    )
+    if b is not None:
+        out2 = helper.create_variable_for_type_inference(dtype=x.dtype)
+        helper.append_op(
+            "elementwise_add", inputs={"X": out, "Y": b},
+            outputs={"Out": out2}, attrs={"axis": 2},
+        )
+        out = out2
+    if act:
+        out = getattr(layers, act)(out)
+    return out
+
+
+def _w_ln(x, scale, bias):
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("wln")
+    y = helper.create_variable_for_type_inference(dtype=x.dtype)
+    mean = helper.create_variable_for_type_inference(dtype="float32", stop_gradient=True)
+    var = helper.create_variable_for_type_inference(dtype="float32", stop_gradient=True)
+    helper.append_op(
+        "layer_norm",
+        inputs={"X": x, "Scale": scale, "Bias": bias},
+        outputs={"Y": y, "Mean": mean, "Variance": var},
+        attrs={"begin_norm_axis": 2, "epsilon": 1e-5},
+    )
+    return y
+
+
+def _w_sdpa(q, k, v, bias, cfg, is_test):
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("wsdpa")
+    ctx = helper.create_variable_for_type_inference(dtype=cfg.dtype)
+    lse = helper.create_variable_for_type_inference(dtype="float32")
+    lse.stop_gradient = True
+    inputs = {"Q": q, "K": k, "V": v}
+    if bias is not None:
+        inputs["Bias"] = bias
+    helper.append_op(
+        "scaled_dot_product_attention",
+        inputs=inputs,
+        outputs={"Out": ctx, "Lse": lse},
+        attrs={
+            "scale": 1.0 / math.sqrt(cfg.d_head),
+            "dropout_prob": float(cfg.dropout),
+            "is_test": is_test,
+        },
+    )
+    return ctx
+
+
+def _w_attention(q_in, kv_in, bias, cfg, weights, is_test, fused_qkv):
+    h, dh, d = cfg.n_head, cfg.d_head, cfg.d_model
+
+    def split_heads(z):
+        z = layers.reshape(z, [0, 0, h, dh])
+        return layers.transpose(z, [0, 2, 1, 3])
+
+    if fused_qkv:
+        qkv = _w_fc(q_in, weights["qkv.w"], weights["qkv.b"])
+        q, k, v = layers.split(qkv, 3, dim=-1)
+    else:
+        q = _w_fc(q_in, weights["q.w"], weights["q.b"])
+        k = _w_fc(kv_in, weights["k.w"], weights["k.b"])
+        v = _w_fc(kv_in, weights["v.w"], weights["v.b"])
+    ctx = _w_sdpa(split_heads(q), split_heads(k), split_heads(v), bias,
+                  cfg, is_test)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, d])
+    return _w_fc(ctx, weights["out.w"], weights["out.b"])
+
+
+def _w_drop_add(x, residual, cfg, is_test):
+    if cfg.dropout and not is_test:
+        x = layers.dropout(x, cfg.dropout, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    return layers.elementwise_add(x, residual)
+
+
+# (slot key, per-layer shape fn, maps-from per-layer param name fn)
+def _enc_weight_specs(cfg):
+    d, di = cfg.d_model, cfg.d_inner
+    return [
+        ("preattn_ln.scale", [d], lambda i: f"enc{i}_preattn_ln.scale"),
+        ("preattn_ln.bias", [d], lambda i: f"enc{i}_preattn_ln.bias"),
+        ("qkv.w", [d, 3 * d], lambda i: f"enc{i}_attn_qkv_colp.w"),
+        ("qkv.b", [3 * d], lambda i: f"enc{i}_attn_qkv_colp.b"),
+        ("out.w", [d, d], lambda i: f"enc{i}_attn_out_rowp.w"),
+        ("out.b", [d], lambda i: f"enc{i}_attn_out_rowp.b"),
+        ("preffn_ln.scale", [d], lambda i: f"enc{i}_preffn_ln.scale"),
+        ("preffn_ln.bias", [d], lambda i: f"enc{i}_preffn_ln.bias"),
+        ("ffn1.w", [d, di], lambda i: f"enc{i}_ffn1_colp.w"),
+        ("ffn1.b", [di], lambda i: f"enc{i}_ffn1_colp.b"),
+        ("ffn2.w", [di, d], lambda i: f"enc{i}_ffn2_rowp.w"),
+        ("ffn2.b", [d], lambda i: f"enc{i}_ffn2_rowp.b"),
+    ]
+
+
+def _dec_weight_specs(cfg):
+    d, di = cfg.d_model, cfg.d_inner
+    specs = [
+        ("preself_ln.scale", [d], lambda i: f"dec{i}_preself_ln.scale"),
+        ("preself_ln.bias", [d], lambda i: f"dec{i}_preself_ln.bias"),
+        ("self_q.w", [d, d], lambda i: f"dec{i}_self_q_colp.w"),
+        ("self_q.b", [d], lambda i: f"dec{i}_self_q_colp.b"),
+        ("self_k.w", [d, d], lambda i: f"dec{i}_self_k_colp.w"),
+        ("self_k.b", [d], lambda i: f"dec{i}_self_k_colp.b"),
+        ("self_v.w", [d, d], lambda i: f"dec{i}_self_v_colp.w"),
+        ("self_v.b", [d], lambda i: f"dec{i}_self_v_colp.b"),
+        ("self_out.w", [d, d], lambda i: f"dec{i}_self_out_rowp.w"),
+        ("self_out.b", [d], lambda i: f"dec{i}_self_out_rowp.b"),
+        ("precross_ln.scale", [d], lambda i: f"dec{i}_precross_ln.scale"),
+        ("precross_ln.bias", [d], lambda i: f"dec{i}_precross_ln.bias"),
+        ("q.w", [d, d], lambda i: f"dec{i}_cross_q_colp.w"),
+        ("q.b", [d], lambda i: f"dec{i}_cross_q_colp.b"),
+        ("k.w", [d, d], lambda i: f"dec{i}_cross_k_colp.w"),
+        ("k.b", [d], lambda i: f"dec{i}_cross_k_colp.b"),
+        ("v.w", [d, d], lambda i: f"dec{i}_cross_v_colp.w"),
+        ("v.b", [d], lambda i: f"dec{i}_cross_v_colp.b"),
+        ("cross_out.w", [d, d], lambda i: f"dec{i}_cross_out_rowp.w"),
+        ("cross_out.b", [d], lambda i: f"dec{i}_cross_out_rowp.b"),
+        ("preffn_ln.scale", [d], lambda i: f"dec{i}_preffn_ln.scale"),
+        ("preffn_ln.bias", [d], lambda i: f"dec{i}_preffn_ln.bias"),
+        ("ffn1.w", [d, di], lambda i: f"dec{i}_ffn1_colp.w"),
+        ("ffn1.b", [di], lambda i: f"dec{i}_ffn1_colp.b"),
+        ("ffn2.w", [di, d], lambda i: f"dec{i}_ffn2_rowp.w"),
+        ("ffn2.b", [d], lambda i: f"dec{i}_ffn2_rowp.b"),
+    ]
+    return specs
+
+
+def _layer_scan(x, cfg, specs, body_fn, stack_prefix, is_test,
+                captured_extra=()):
+    """Run ``body_fn(x_var, weights)`` once per layer via the scan op,
+    with each weight kind stacked [n_layer, ...] and scanned."""
+    from paddle_tpu.layer_helper import LayerHelper
+    from paddle_tpu.layers.control_flow import _captured_names
+
+    prog = fluid.default_main_program()
+    parent = prog.current_block()
+    helper = LayerHelper(stack_prefix)
+    stacked = {}
+    for key, shape, _src in specs:
+        is_bias_like = len(shape) == 1
+        if is_bias_like:
+            init = fluid.initializer.ConstantInitializer(
+                1.0 if key.endswith("ln.scale") else 0.0)
+        else:
+            # match build()'s LayerHelper default (Xavier over the
+            # PER-LAYER fan, not the stacked shape) so from-scratch runs
+            # start from the same distribution in both modes
+            init = fluid.initializer.XavierInitializer(
+                fan_in=shape[0], fan_out=shape[1])
+        stacked[key] = helper.create_parameter(
+            ParamAttr(name=f"{stack_prefix}_{key}_stacked",
+                      initializer=init),
+            shape=[cfg.n_layer] + shape,
+            dtype=cfg.dtype,
+        )
+
+    sub = prog._create_block()
+    try:
+        slice_vars = {}
+        for key, shape, _src in specs:
+            slice_vars[key] = sub.create_var(
+                name=unique_name.generate(f"{stack_prefix}_{key}_slice"),
+                dtype=cfg.dtype, shape=tuple(shape),
+            )
+        x_in = sub.create_var(
+            name=unique_name.generate(f"{stack_prefix}_carry"),
+            dtype=x.dtype, shape=x.shape,
+        )
+        x_out = body_fn(x_in, slice_vars)
+    finally:
+        prog._rollback()
+
+    x_names = [slice_vars[k].name for k, _s, _f in specs]
+    captured = _captured_names(sub, parent, exclude=x_names + [x_in.name])
+    final = parent.create_var(
+        name=unique_name.generate(f"{stack_prefix}_out"),
+        dtype=x.dtype, shape=x.shape,
+    )
+    parent.append_op(
+        "scan",
+        inputs={
+            "X": [stacked[k].name for k, _s, _f in specs],
+            "Init": [x.name],
+            "Captured": captured,
+        },
+        outputs={"Y": [], "FinalState": [final.name]},
+        attrs={
+            "sub_block": sub,
+            "x_names": x_names,
+            "state_in_names": [x_in.name],
+            "state_out_names": [x_out.name],
+            "y_names": [],
+            "captured_names": captured,
+        },
+    )
+    return final
+
+
+def build_scan(cfg: Optional[TransformerConfig] = None,
+               is_test: bool = False):
+    """Same model as build() with the layer stacks rolled into scan ops.
+    Parameters are stacked per weight kind (``enc_stack_*_stacked``
+    [n_layer, ...]); use ``stack_weights_from_layers`` to map build()'s
+    per-layer weights onto them for parity checks."""
+    cfg = cfg or base()
+    (src, trg, lbl, src_pad, trg_pad,
+     enc_bias, dec_self_bias) = _train_feeds_and_biases()
+
+    enc_in = _embed(src, cfg.src_vocab_size, cfg, "src_emb.w", "src_pos.w",
+                    is_test)
+
+    def enc_body(x, w):
+        attn = _w_attention(
+            _w_ln(x, w["preattn_ln.scale"], w["preattn_ln.bias"]), None,
+            enc_bias, cfg,
+            {"qkv.w": w["qkv.w"], "qkv.b": w["qkv.b"],
+             "out.w": w["out.w"], "out.b": w["out.b"]},
+            is_test, fused_qkv=True)
+        x = _w_drop_add(attn, x, cfg, is_test)
+        ff = _w_fc(
+            _w_ln(x, w["preffn_ln.scale"], w["preffn_ln.bias"]),
+            w["ffn1.w"], w["ffn1.b"], act="relu")
+        if cfg.dropout and not is_test:
+            ff = layers.dropout(ff, cfg.dropout, is_test=is_test,
+                                dropout_implementation="upscale_in_train")
+        ff = _w_fc(ff, w["ffn2.w"], w["ffn2.b"])
+        return _w_drop_add(ff, x, cfg, is_test)
+
+    enc = _layer_scan(enc_in, cfg, _enc_weight_specs(cfg), enc_body,
+                      "enc_stack", is_test)
+    enc = _ln(enc, "enc_post")
+
+    dec_in = _embed(trg, cfg.trg_vocab_size, cfg, "trg_emb.w", "trg_pos.w",
+                    is_test)
+
+    def dec_body(x, w):
+        # build()'s decoder self-attention projects q/k/v separately (its
+        # two _ln calls are distinct vars, so the fused-qkv branch never
+        # fires there) — mirror that exactly for weight-level parity
+        ln_self = _w_ln(x, w["preself_ln.scale"], w["preself_ln.bias"])
+        attn = _w_attention(
+            ln_self, ln_self, dec_self_bias, cfg,
+            {"q.w": w["self_q.w"], "q.b": w["self_q.b"],
+             "k.w": w["self_k.w"], "k.b": w["self_k.b"],
+             "v.w": w["self_v.w"], "v.b": w["self_v.b"],
+             "out.w": w["self_out.w"], "out.b": w["self_out.b"]},
+            is_test, fused_qkv=False)
+        x = _w_drop_add(attn, x, cfg, is_test)
+        ln_x = _w_ln(x, w["precross_ln.scale"], w["precross_ln.bias"])
+        cross = _w_attention(
+            ln_x, enc, enc_bias, cfg,
+            {"q.w": w["q.w"], "q.b": w["q.b"], "k.w": w["k.w"],
+             "k.b": w["k.b"], "v.w": w["v.w"], "v.b": w["v.b"],
+             "out.w": w["cross_out.w"], "out.b": w["cross_out.b"]},
+            is_test, fused_qkv=False)
+        x = _w_drop_add(cross, x, cfg, is_test)
+        ff = _w_fc(
+            _w_ln(x, w["preffn_ln.scale"], w["preffn_ln.bias"]),
+            w["ffn1.w"], w["ffn1.b"], act="relu")
+        if cfg.dropout and not is_test:
+            ff = layers.dropout(ff, cfg.dropout, is_test=is_test,
+                                dropout_implementation="upscale_in_train")
+        ff = _w_fc(ff, w["ffn2.w"], w["ffn2.b"])
+        return _w_drop_add(ff, x, cfg, is_test)
+
+    dec = _layer_scan(dec_in, cfg, _dec_weight_specs(cfg), dec_body,
+                      "dec_stack", is_test)
+    dec = _ln(dec, "dec_post")
+
+    logits, token_count, loss = _loss_head(dec, lbl, trg_pad, cfg)
+    return {
+        "feeds": [src, trg, lbl, src_pad, trg_pad],
+        "loss": loss,
+        "logits": logits,
+        "token_count": token_count,
+        "config": cfg,
+    }
+
+
+def stack_weights_from_layers(cfg, per_layer_scope, scan_scope):
+    """Copy build()-style per-layer weights into build_scan()'s stacked
+    parameters (for parity tests / migration)."""
+    for prefix, specs in (("enc_stack", _enc_weight_specs(cfg)),
+                          ("dec_stack", _dec_weight_specs(cfg))):
+        for key, _shape, src_fn in specs:
+            stack = np.stack([
+                np.asarray(per_layer_scope.find_var(src_fn(i)))
+                for i in range(cfg.n_layer)
+            ])
+            scan_scope.set(f"{prefix}_{key}_stacked", stack)

@@ -124,8 +124,11 @@ def _sdpa(ins, attrs, rng=None):
                                 scale=scale, bias=bias, data_axis=data_axis)
         lse = jnp.zeros(jnp.shape(q)[:3] + (1,), jnp.float32)
     elif use_pallas:
-        out, lse = fa.flash_attention_fwd(q, k, v, bias=bias, seed=seed,
-                                          scale=scale, p_drop=drop)
+        # the custom-vjp wrapper makes the op differentiable through
+        # jax.vjp too (scan-over-layers grad); the paired grad op below
+        # remains the unrolled path's backward
+        out, lse = fa.flash_attention_with_lse(q, k, v, bias, seed,
+                                               scale, float(drop))
     else:
         out = fa._reference_attention(q, k, v, bias, scale, drop,
                                       seed if drop > 0.0 else None)

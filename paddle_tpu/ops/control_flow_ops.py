@@ -159,6 +159,8 @@ def _scan(ins, attrs, rng=None):
     else:
         n_steps = int(attrs["n_steps"])
 
+    init_dtypes = [jnp.result_type(v) for v in init]
+
     def body(carry, step):
         i, xt = step
         env = _sub_env(cap_names, cap_vals)
@@ -166,7 +168,12 @@ def _scan(ins, attrs, rng=None):
         env.update(zip(x_names, xt))
         key = jax.random.fold_in(rng, i) if rng is not None else None
         interp.exec_ops(sub_ops, env, key=key, amp=amp)
-        new_carry = tuple(env[n] for n in s_out)
+        # AMP may narrow a carried activation to bf16 mid-body; scan
+        # requires carry-in/carry-out types to match, so restore the
+        # initial dtypes at the step boundary.
+        new_carry = tuple(
+            env[n].astype(dt) for n, dt in zip(s_out, init_dtypes)
+        )
         ys = tuple(env[n] for n in y_names)
         return new_carry, ys
 

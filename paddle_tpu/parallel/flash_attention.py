@@ -537,3 +537,45 @@ def _vjp_bwd(scale, p_drop, q_block, k_block, res, g):
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# --- custom-vjp wrapper ---
+#
+# pallas_call has no JVP rule, so any path that differentiates the forward
+# through jax.vjp (the scan-over-layers grad, ring-attention fallback,
+# ad-hoc jax.grad over a model fn) would fail on TPU. This wrapper teaches
+# autodiff to use the blocked backward kernels instead; the paired
+# `scaled_dot_product_attention_grad` op remains for the unrolled Program
+# path, sharing the same kernels.
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def flash_attention_with_lse(q, k, v, bias=None, seed=None,
+                             scale: Optional[float] = None,
+                             p_drop: float = 0.0,
+                             q_block: int = DEFAULT_Q_BLOCK,
+                             k_block: int = DEFAULT_K_BLOCK):
+    """(out, lse) variant of ``flash_attention`` — same backward rule
+    (shared ``_vjp_bwd``: blocked Pallas kernels, true dbias on the dense
+    fallback, float0 seed cotangent). The sdpa op uses this so its saved
+    Lse output exists AND jax.vjp through the op (scan-over-layers grad)
+    works despite pallas_call having no JVP rule."""
+    return flash_attention_fwd(q, k, v, bias, seed, scale, p_drop,
+                               q_block, k_block)
+
+
+def _fa_lse_vjp_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block):
+    out, lse = flash_attention_fwd(q, k, v, bias, seed, scale, p_drop,
+                                   q_block, k_block)
+    return (out, lse), (q, k, v, bias, seed, out, lse)
+
+
+def _fa_lse_vjp_bwd(scale, p_drop, q_block, k_block, res, gs):
+    g, _g_lse = gs  # lse is a saved statistic, not a training signal
+    q = res[0]
+    return _vjp_bwd(scale, p_drop, q_block, k_block, res,
+                    g.astype(q.dtype))
+
+
+flash_attention_with_lse.defvjp(_fa_lse_vjp_fwd, _fa_lse_vjp_bwd)
+
