@@ -120,7 +120,7 @@ def main():
     ]
     for f in feeds[:2]:
         exe.run(main_prog, feed=f, fetch_list=[model["loss"]])
-    steps = 20
+    steps = 60  # longer window: the tunnel adds per-run noise
     t0 = time.time()
     loss = None
     for i in range(steps):

@@ -111,7 +111,7 @@ def main():
     ]
     for fd in feeds[:2]:
         exe.run(main_prog, feed=fd, fetch_list=[model["loss"]])
-    steps = 20
+    steps = 60  # longer window: the tunnel adds per-run noise
     t0 = time.time()
     loss = None
     for i in range(steps):
