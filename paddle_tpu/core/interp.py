@@ -15,6 +15,7 @@ same as top-level blocks under mixed precision.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import contextvars
 from typing import Any, Dict, List, Optional
@@ -86,10 +87,16 @@ def set_amp_active(flag: bool):
 
 
 # SPMD context for ops that need explicit shard_map collectives (ring
-# attention over a context axis, psum-sharded embedding tables) rather than
-# relying on GSPMD propagation. Set by the Executor while tracing a program
-# compiled with a DistributedStrategy that declares those axes; kernels read
-# it at trace time. (mesh, context_axis, table_axis, data_axis) or None.
+# attention over a context axis, psum-sharded embedding tables, expert-
+# parallel MoE all_to_all dispatch) rather than relying on GSPMD
+# propagation. Set by the Executor while tracing a program compiled with a
+# DistributedStrategy that declares those axes; kernels read it at trace
+# time. An ``SpmdCtx`` or None.
+SpmdCtx = collections.namedtuple(
+    "SpmdCtx", ["mesh", "context_axis", "table_axis", "data_axis",
+                "expert_axis"]
+)
+
 _SPMD_CTX: contextvars.ContextVar = contextvars.ContextVar(
     "paddle_tpu_spmd_ctx", default=None
 )
@@ -106,12 +113,21 @@ def set_spmd_ctx(ctx):
 @contextlib.contextmanager
 def spmd_ctx_scope(strategy):
     """Activate a DistributedStrategy's SPMD context (ring attention /
-    sharded tables) for the enclosed trace. The single place that builds
-    the context tuple — keep kernels' destructuring in sync with it."""
+    sharded tables / expert-parallel MoE) for the enclosed trace. The
+    single place that builds the context — kernels read fields by name."""
     ctx = None
-    if strategy is not None and (strategy.context_axis or strategy.table_axis):
-        ctx = (strategy.mesh, strategy.context_axis, strategy.table_axis,
-               strategy.data_axis)
+    if strategy is not None and (
+        strategy.context_axis
+        or strategy.table_axis
+        or getattr(strategy, "expert_axis", None)
+    ):
+        ctx = SpmdCtx(
+            mesh=strategy.mesh,
+            context_axis=strategy.context_axis,
+            table_axis=strategy.table_axis,
+            data_axis=strategy.data_axis,
+            expert_axis=getattr(strategy, "expert_axis", None),
+        )
     tok = _SPMD_CTX.set(ctx)
     try:
         yield

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Union
 
+from paddle_tpu import unique_name
 from paddle_tpu.framework import Variable
 from paddle_tpu.initializer import ConstantInitializer
 from paddle_tpu.layer_helper import LayerHelper
@@ -32,7 +33,7 @@ __all__ = [
     "flatten", "sums", "elementwise_mod", "elementwise_floordiv", "maxout",
     "mean_iou",
     "linear_chain_crf", "crf_decoding", "warpctc", "edit_distance",
-    "bilinear_tensor_product", "nce",
+    "bilinear_tensor_product", "nce", "switch_moe",
 ]
 
 
@@ -1191,3 +1192,60 @@ def nce(input, label, num_total_classes, num_neg_samples=10,
         attrs={"num_neg_samples": num_neg_samples},
     )
     return cost
+
+
+def switch_moe(input, num_experts, d_ff=None, capacity_factor=2.0,
+               act="relu", param_attr=None, name=None):
+    """Switch-style top-1 Mixture-of-Experts FFN (net-new vs the
+    reference; SURVEY.md section 2.3 "EP, MoE"). Returns
+    ``(out, aux_loss)``: out has the input's shape; add a multiple of
+    ``aux_loss`` (Switch uses ~0.01) to the training loss for load
+    balancing.
+
+    Under ``CompiledProgram.with_strategy`` with a strategy declaring
+    ``expert_axis`` (mesh axis of size ``num_experts``), experts shard
+    one-per-rank and tokens travel over ICI all_to_all; otherwise the
+    identical fixed-capacity math runs on one device. Parameter naming
+    matches ``parallel.strategy.moe_rules``: ``{name}_experts.{w1,...}``
+    stacked [E, ...] weights, ``{name}_gate.w`` router.
+    """
+    from paddle_tpu.initializer import NormalInitializer
+
+    helper = LayerHelper("switch_moe", name=name)
+    d = input.shape[-1]
+    d_ff = d_ff or 4 * d
+
+    def param(suffix, shape, is_bias=False):
+        base = ParamAttr._to_attr(param_attr) or ParamAttr()
+        # Keep the user's attr fields; only the name is forced (the
+        # _experts./_gate. naming is the moe_rules sharding contract).
+        attr = ParamAttr(
+            name=unique_name.generate(f"{helper.name}{suffix}"),
+            initializer=base.initializer,
+            learning_rate=base.learning_rate,
+            regularizer=base.regularizer,
+            trainable=base.trainable,
+        )
+        init = (ConstantInitializer(0.0) if is_bias
+                else NormalInitializer(0.0, 0.02))
+        return helper.create_parameter(
+            attr, shape=shape, dtype=input.dtype, is_bias=is_bias,
+            default_initializer=init,
+        )
+
+    gate_w = param("_gate.w", [d, num_experts])
+    w1 = param("_experts.w1", [num_experts, d, d_ff])
+    b1 = param("_experts.b1", [num_experts, d_ff], is_bias=True)
+    w2 = param("_experts.w2", [num_experts, d_ff, d])
+    b2 = param("_experts.b2", [num_experts, d], is_bias=True)
+
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    aux = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        "switch_moe",
+        inputs={"X": input, "GateW": gate_w, "W1": w1, "B1": b1,
+                "W2": w2, "B2": b2},
+        outputs={"Out": out, "AuxLoss": aux},
+        attrs={"capacity_factor": float(capacity_factor), "act": act},
+    )
+    return out, aux

@@ -14,6 +14,7 @@ from paddle_tpu.ops import (  # noqa: F401
     crf_ops,
     decode_ops,
     math_ops,
+    moe_ops,
     nn_ops,
     optimizer_ops,
     rnn_ops,

@@ -88,7 +88,7 @@ def _ring_config(q, k, drop):
     ctx = spmd_ctx()
     if ctx is None:
         return None
-    mesh, ctx_axis, _table_axis, data_axis = ctx
+    mesh, ctx_axis, data_axis = ctx.mesh, ctx.context_axis, ctx.data_axis
     if ctx_axis is None or drop > 0.0:
         return None
     n = mesh.shape[ctx_axis]

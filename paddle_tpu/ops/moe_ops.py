@@ -1,0 +1,110 @@
+"""Expert-parallel Mixture-of-Experts op.
+
+Net-new capability vs the reference (SURVEY.md section 2.3: "EP, MoE —
+absent in reference; in-scope as native capabilities"). This op makes
+``parallel/moe.py`` reachable from the Program IR the same way ring
+attention is reachable from scaled_dot_product_attention: when the program
+runs under a DistributedStrategy declaring an ``expert_axis``, tokens are
+dispatched over ICI with ``lax.all_to_all`` (one expert per rank);
+otherwise the identical fixed-capacity Switch math runs densely on one
+device, so 1-device and n-device runs of the same program are comparable.
+
+Inputs: X [.., d] tokens (any leading shape), GateW [d, E] router,
+stacked expert FFN weights W1 [E, d, dff], B1 [E, dff], W2 [E, dff, d],
+B2 [E, d]. Outputs: Out (same shape as X), AuxLoss [] (Switch
+load-balancing loss; add ``aux_weight * AuxLoss`` to the training loss).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+_ACTS = {
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def _x(ins, slot, i=0):
+    v = ins.get(slot)
+    return v[i] if v else None
+
+
+@register_op(
+    "switch_moe",
+    diff_inputs=("X", "GateW", "W1", "B1", "W2", "B2"),
+    doc="Switch-style top-1 MoE FFN; expert-parallel all_to_all dispatch "
+        "under a strategy expert axis (parallel/moe.py)",
+)
+def _switch_moe(ins, attrs):
+    x = _x(ins, "X")
+    gate_w = _x(ins, "GateW")
+    w1, b1 = _x(ins, "W1"), _x(ins, "B1")
+    w2, b2 = _x(ins, "W2"), _x(ins, "B2")
+    act = _ACTS[attrs.get("act", "relu")]
+    cap_factor = float(attrs.get("capacity_factor", 2.0))
+    e = int(gate_w.shape[-1])
+
+    shape = jnp.shape(x)
+    d = shape[-1]
+    xf = jnp.reshape(x, (-1, d))
+    n = int(xf.shape[0])
+    # Router math in f32 regardless of the AMP activation stream: argmax
+    # ties and softmax fractions are routing decisions, not a bandwidth
+    # bound, and bf16 routing can diverge between runs.
+    gate_w = gate_w.astype(jnp.float32)
+
+    def ffn(p, t):
+        pw1, pb1, pw2, pb2 = p
+        h = act(t @ pw1.astype(t.dtype) + pb1.astype(t.dtype))
+        return h @ pw2.astype(t.dtype) + pb2.astype(t.dtype)
+
+    params = (w1, b1, w2, b2)
+
+    from paddle_tpu.core.interp import spmd_ctx
+    from paddle_tpu.parallel import moe
+
+    ctx = spmd_ctx()
+    dist = None
+    if ctx is not None and ctx.expert_axis is not None:
+        mesh = ctx.mesh
+        # A declared expert axis that cannot serve this op is a strategy
+        # configuration error, not a fallback case: silently running the
+        # dense path would leave the [E, ...] expert weights sharded by
+        # moe_rules with no all_to_all — GSPMD would all-gather them every
+        # step with no signal (cf. DistributedStrategy strict rationale).
+        if mesh.shape[ctx.expert_axis] != e:
+            raise ValueError(
+                f"switch_moe: strategy expert_axis '{ctx.expert_axis}' has "
+                f"mesh size {mesh.shape[ctx.expert_axis]} but the op has "
+                f"{e} experts; they must match (one expert per rank)"
+            )
+        data_axis = ctx.data_axis
+        n_ranks = mesh.shape.get(data_axis, 1) if data_axis else 1
+        if data_axis is not None and n % n_ranks != 0:
+            raise ValueError(
+                f"switch_moe: {n} tokens do not divide the data axis "
+                f"'{data_axis}' ({n_ranks} ranks)"
+            )
+        dist = (mesh, ctx.expert_axis, data_axis, n_ranks)
+
+    n_loc = n // (dist[3] if dist else 1)
+    capacity = max(1, int(cap_factor * n_loc / e))
+
+    if dist is not None:
+        mesh, expert_axis, data_axis, _ = dist
+        out, aux = moe.moe_ffn(
+            xf, gate_w, params, ffn, mesh,
+            expert_axis=expert_axis, data_axis=data_axis, capacity=capacity,
+        )
+    else:
+        out, aux = moe.moe_dense(xf, gate_w, params, ffn, capacity)
+    return {
+        "Out": [jnp.reshape(out, shape).astype(x.dtype)],
+        "AuxLoss": [aux.astype(jnp.float32)],
+    }

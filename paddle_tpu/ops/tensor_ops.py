@@ -239,7 +239,7 @@ def _lookup_table(ins, attrs):
 
         ctx = spmd_ctx()
         if ctx is not None:
-            mesh, _ctx_axis, table_axis, data_axis = ctx
+            mesh, table_axis, data_axis = ctx.mesh, ctx.table_axis, ctx.data_axis
             if table_axis is not None and (
                 jnp.shape(w)[0] % mesh.shape[table_axis] == 0
             ):

@@ -30,33 +30,44 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _gate_and_dispatch(x, gate_w, e: int, capacity: int):
+    """Shared Switch-style top-1 gating + fixed-capacity dispatch math.
+
+    One source of truth for both the expert-parallel per-rank body and the
+    dense single-device path, so the two are bit-comparable in parity
+    tests. Returns (dispatch [e, cap, d], dst, slot, keep, gate_val,
+    onehot, probs)."""
+    logits = x @ gate_w                          # [n, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)      # [n]
+    gate_val = jnp.max(probs, axis=-1)           # [n]
+
+    # position of each token within its expert's capacity window
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)   # [n, E]
+    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot       # 1-based
+    pos = jnp.sum(pos_in_expert, axis=-1) - 1                 # [n]
+    keep = (pos >= 0) & (pos < capacity)
+
+    # dispatch[e_dst, cap, d]: tokens sent to each expert
+    dispatch = jnp.zeros((e, capacity, x.shape[-1]), x.dtype)
+    dst = jnp.where(keep, expert_idx, e - 1)
+    slot = jnp.clip(pos, 0, capacity - 1)
+    contrib = jnp.where(keep[:, None], x, 0.0)
+    dispatch = dispatch.at[dst, slot].add(contrib)
+    return dispatch, dst, slot, keep, gate_val, onehot, probs
+
+
 def _moe_local(gate_w, expert_params, x, *, fn: Callable, axis: str,
-               capacity: int):
+               capacity: int, data_axis: Optional[str] = None):
     """Per-rank body. x: [n_loc, d] this rank's tokens (batch-sharded);
     gate_w: [d, E] replicated; expert_params: this rank's expert (leading
     axis sliced to 1 by shard_map)."""
     e = lax.psum(1, axis)
     n_loc, d = x.shape
 
-    # --- top-1 gating (Switch-style), computed on local tokens ---
-    logits = x @ gate_w                          # [n_loc, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)      # [n_loc]
-    gate_val = jnp.max(probs, axis=-1)           # [n_loc]
-
-    # --- build fixed-capacity dispatch buffers per destination expert ---
-    # position of each token within its expert's capacity window
-    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)   # [n_loc, E]
-    pos_in_expert = jnp.cumsum(onehot, axis=0) * onehot       # 1-based
-    pos = jnp.sum(pos_in_expert, axis=-1) - 1                 # [n_loc]
-    keep = (pos >= 0) & (pos < capacity)
-
-    # dispatch[e_dst, cap, d]: tokens this rank sends to each expert
-    dispatch = jnp.zeros((e, capacity, d), x.dtype)
-    dst = jnp.where(keep, expert_idx, e - 1)
-    slot = jnp.clip(pos, 0, capacity - 1)
-    contrib = jnp.where(keep[:, None], x, 0.0)
-    dispatch = dispatch.at[dst, slot].add(contrib)
+    dispatch, dst, slot, keep, gate_val, onehot, probs = _gate_and_dispatch(
+        x, gate_w, e, capacity
+    )
 
     # --- all_to_all: axis of experts <-> axis of source ranks ---
     # after the exchange, this rank holds [src_rank, cap, d] tokens for
@@ -78,11 +89,15 @@ def _moe_local(gate_w, expert_params, x, *, fn: Callable, axis: str,
         keep[:, None], gathered * gate_val[:, None], x
     )  # overflow tokens take the identity path
 
-    # auxiliary load-balancing loss (Switch: E * sum(frac_tokens * frac_prob))
+    # auxiliary load-balancing loss (Switch: E * sum(frac_tokens * frac_prob)).
+    # The fractions are means over ALL tokens: pmean over the data axis too
+    # when tokens are batch-sharded, else the aux (and its router gradient)
+    # would be one data shard's local statistics.
+    axes = (axis,) if data_axis is None else (axis, data_axis)
     frac_tokens = jnp.mean(onehot.astype(x.dtype), axis=0)
     frac_probs = jnp.mean(probs, axis=0)
-    aux = e * jnp.sum(lax.pmean(frac_tokens, axis) *
-                      lax.pmean(frac_probs, axis))
+    aux = e * jnp.sum(lax.pmean(frac_tokens, axes) *
+                      lax.pmean(frac_probs, axes))
     return combined, aux
 
 
@@ -95,6 +110,7 @@ def moe_ffn(
     expert_axis: str = "expert",
     data_axis: Optional[str] = None,
     capacity_factor: float = 2.0,
+    capacity: Optional[int] = None,
 ):
     """Expert-parallel MoE layer.
 
@@ -109,7 +125,8 @@ def moe_ffn(
     n = x.shape[0]
     n_ranks = mesh.shape.get(data_axis, 1) if data_axis else 1
     n_loc = n // max(n_ranks, 1)
-    capacity = max(1, int(capacity_factor * n_loc / e))
+    if capacity is None:
+        capacity = max(1, int(capacity_factor * n_loc / e))
 
     param_specs = jax.tree.map(
         lambda p: P(expert_axis, *([None] * (p.ndim - 1))), expert_params
@@ -118,7 +135,8 @@ def moe_ffn(
     def local(gw, params, xs):
         params = jax.tree.map(lambda p: p[0], params)
         return _moe_local(
-            gw, params, xs, fn=fn, axis=expert_axis, capacity=capacity
+            gw, params, xs, fn=fn, axis=expert_axis, capacity=capacity,
+            data_axis=(data_axis if data_axis in mesh.axis_names else None),
         )
 
     out, aux = jax.shard_map(
@@ -133,6 +151,24 @@ def moe_ffn(
         check_vma=False,
     )(gate_w, expert_params, x)
     return out, aux
+
+
+def moe_dense(x, gate_w, expert_params, fn: Callable, capacity: int):
+    """Single-device Switch MoE with the SAME fixed-capacity dispatch math
+    as the expert-parallel path (shared ``_gate_and_dispatch``), so a
+    1-device run is numerically comparable to an n-device expert-parallel
+    run of the same program. Returns (combined [n, d], aux_loss)."""
+    e = jax.tree.leaves(expert_params)[0].shape[0]
+    dispatch, dst, slot, keep, gate_val, onehot, probs = _gate_and_dispatch(
+        x, gate_w, e, capacity
+    )
+    stacked = jax.vmap(fn)(expert_params, dispatch)   # [e, cap, d]
+    gathered = stacked[dst, slot]                # [n, d]
+    combined = jnp.where(keep[:, None], gathered * gate_val[:, None], x)
+    frac_tokens = jnp.mean(onehot.astype(x.dtype), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return combined, aux
 
 
 def moe_reference(x, gate_w, expert_params, fn):

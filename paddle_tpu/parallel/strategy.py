@@ -51,6 +51,7 @@ class DistributedStrategy:
         strict: bool = False,
         context_axis: Optional[str] = None,
         table_axis: Optional[str] = None,
+        expert_axis: Optional[str] = None,
     ):
         self.mesh = mesh
         self.data_axis = data_axis if data_axis in mesh.axis_names else None
@@ -67,6 +68,11 @@ class DistributedStrategy:
         # lookup table / pserver prefetch).
         self.table_axis = (
             table_axis if table_axis in mesh.axis_names else None
+        )
+        # Expert parallelism: switch_moe ops dispatch tokens over this axis
+        # via all_to_all (one expert per rank, parallel/moe.py).
+        self.expert_axis = (
+            expert_axis if expert_axis in mesh.axis_names else None
         )
 
     def spec_for(self, name: str) -> P:
@@ -99,6 +105,18 @@ class DistributedStrategy:
 
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
+
+
+def moe_rules(expert_axis: str = "expert") -> List[ShardingRule]:
+    """Expert-parallel sharding for layers.switch_moe naming: stacked
+    expert weights ``{name}_experts.{w1,b1,w2,b2}`` shard the leading
+    expert dim; the router ``{name}_gate.w`` stays replicated. The (_|$)
+    suffix makes optimizer accumulators inherit the parameter's spec."""
+    e = expert_axis
+    return [
+        ShardingRule(r"_experts\.(w1|b1|w2|b2)(_|$)", P(e)),
+        ShardingRule(r"_gate\.w(_|$)", P()),
+    ]
 
 
 def transformer_rules(model_axis: str = "model") -> List[ShardingRule]:
