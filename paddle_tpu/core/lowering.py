@@ -151,8 +151,15 @@ def jit_lowered(
     in_shardings=None,
     out_shardings=None,
     donate_state: bool = True,
+    fold_step: bool = False,
 ):
-    """Wrap the traced block in jax.jit with parameter-buffer donation."""
+    """Wrap the traced block in jax.jit with parameter-buffer donation.
+
+    ``fold_step``: the returned fn has signature
+    ``fn(state, feeds, base_key, step)`` and derives the per-step key with
+    ``fold_in`` INSIDE the compiled computation — host-side key derivation
+    costs two extra device dispatches per step (measured ~10 ms through
+    the hosted-TPU tunnel)."""
     kwargs: Dict[str, Any] = {}
     if donate_state:
         kwargs["donate_argnums"] = (0,)
@@ -160,4 +167,10 @@ def jit_lowered(
         kwargs["in_shardings"] = in_shardings
     if out_shardings is not None:
         kwargs["out_shardings"] = out_shardings
-    return jax.jit(lowered.fn, **kwargs)
+    if not fold_step:
+        return jax.jit(lowered.fn, **kwargs)
+
+    def step_fn(state, feeds, base_key, step):
+        return lowered.fn(state, feeds, jax.random.fold_in(base_key, step))
+
+    return jax.jit(step_fn, **kwargs)

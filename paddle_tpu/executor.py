@@ -100,6 +100,7 @@ class Executor:
         self.place = place if place is not None else TPUPlace(0)
         self._cache: Dict[tuple, Any] = {}
         self._step = 0
+        self._base_keys: Dict[tuple, Any] = {}
 
     # --- public API ---
 
@@ -179,11 +180,17 @@ class Executor:
             state[n] = v
 
         seed = program.random_seed if program.random_seed is not None else 0
-        # typed key: carries its impl (rbg on TPU) through jit/fold_in,
-        # unlike the legacy raw-uint32 PRNGKey
-        rng = jax.random.fold_in(
-            jax.random.key(seed, impl=_prng_impl()), self._step
-        )
+        # typed base key (rbg on TPU), created ONCE per (seed, impl): the
+        # per-step fold_in happens INSIDE the compiled step (the step index
+        # rides along as a scalar arg), because two extra host-side jit
+        # dispatches per step measured ~10 ms/step through the hosted-TPU
+        # tunnel — more than 15% of a transformer-base training step.
+        impl = _prng_impl()
+        base_key = self._base_keys.get((seed, impl))
+        if base_key is None:
+            base_key = jax.random.key(seed, impl=impl)
+            self._base_keys[(seed, impl)] = base_key
+        step_idx = self._step
         self._step += 1
 
         if compiled is not None:
@@ -198,7 +205,8 @@ class Executor:
         with _interp.spmd_ctx_scope(strategy), \
                 _profiler.record_event("executor.run_step"):
             try:
-                fetches, new_state = fn(state, feed_vals, rng)
+                fetches, new_state = fn(state, feed_vals, base_key,
+                                        np.uint32(step_idx))
             except Exception:
                 # State buffers were donated to the failed call; any that
                 # were actually consumed are now deleted. Drop those scope
@@ -259,7 +267,12 @@ class Executor:
         in_shardings = out_shardings = None
         if compiled is not None:
             in_shardings, out_shardings = compiled.shardings(lowered)
+            if in_shardings is not None:
+                # align with fn(state, feeds, key, step)
+                repl = in_shardings[2]
+                in_shardings = (*in_shardings, repl)
         fn = lowering.jit_lowered(
-            lowered, in_shardings=in_shardings, out_shardings=out_shardings
+            lowered, in_shardings=in_shardings, out_shardings=out_shardings,
+            fold_step=True,
         )
         return fn, lowered

@@ -75,14 +75,15 @@ def _sdpa_config(ins, attrs, rng):
     return scale, drop, seed, use_pallas
 
 
-def _ring_config(q, k, drop):
+def _ring_config_t(q, k, drop, t_axis=2):
     """(mesh, context_axis, data_axis) when sequence-parallel ring
     attention applies, else None. Requires a strategy-declared context
     axis, BOTH sequence lengths divisible by the axis size (cross
     attention has tq != tk), and no attention dropout (the ring kernel
     computes the softmax online across rotating K/V blocks, so a
     per-element dropout mask over the full row never exists on one
-    chip). Non-qualifying attention falls back to the flash/dense path."""
+    chip). Non-qualifying attention falls back to the flash/dense path.
+    ``t_axis`` is the sequence dim: 2 for BHTD, 1 for BTHD."""
     from paddle_tpu.core.interp import spmd_ctx
 
     ctx = spmd_ctx()
@@ -92,9 +93,14 @@ def _ring_config(q, k, drop):
     if ctx_axis is None or drop > 0.0:
         return None
     n = mesh.shape[ctx_axis]
-    if n <= 1 or jnp.shape(q)[2] % n != 0 or jnp.shape(k)[2] % n != 0:
+    if (n <= 1 or jnp.shape(q)[t_axis] % n != 0
+            or jnp.shape(k)[t_axis] % n != 0):
         return None
     return mesh, ctx_axis, data_axis
+
+
+def _ring_config(q, k, drop):
+    return _ring_config_t(q, k, drop, 2)
 
 
 @register_op("scaled_dot_product_attention", diff_inputs=("Q", "K", "V"),
@@ -113,16 +119,34 @@ def _sdpa(ins, attrs, rng=None):
     q, k, v = _x(ins, "Q"), _x(ins, "K"), _x(ins, "V")
     bias = _x(ins, "Bias")
     scale, drop, seed, use_pallas = _sdpa_config(ins, attrs, rng)
+    bthd = attrs.get("layout", "bhtd") == "bthd"
     from paddle_tpu.parallel import flash_attention as fa
 
-    ring = _ring_config(q, k, drop)
+    t_axis = 1 if bthd else 2
+    ring = _ring_config_t(q, k, drop, t_axis)
     if ring is not None:
         mesh, ctx_axis, data_axis = ring
         from paddle_tpu.parallel import ring_attention as ra
 
-        out = ra.ring_attention(q, k, v, mesh, seq_axis=ctx_axis,
-                                scale=scale, bias=bias, data_axis=data_axis)
+        if bthd:  # ring kernel operates on [b, h, t, dh]
+            out = ra.ring_attention(
+                jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                jnp.swapaxes(v, 1, 2), mesh, seq_axis=ctx_axis,
+                scale=scale, bias=bias, data_axis=data_axis)
+            out = jnp.swapaxes(out, 1, 2)
+        else:
+            out = ra.ring_attention(q, k, v, mesh, seq_axis=ctx_axis,
+                                    scale=scale, bias=bias,
+                                    data_axis=data_axis)
         lse = jnp.zeros(jnp.shape(q)[:3] + (1,), jnp.float32)
+    elif bthd:
+        if use_pallas:
+            out, lse = fa.flash_attention_bthd_with_lse(
+                q, k, v, bias, seed, scale, float(drop))
+        else:
+            out = fa._reference_attention_bthd(
+                q, k, v, bias, scale, drop, seed if drop > 0.0 else None)
+            lse = jnp.zeros(jnp.shape(q)[:3] + (1,), jnp.float32)
     elif use_pallas:
         # the custom-vjp wrapper makes the op differentiable through
         # jax.vjp too (scan-over-layers grad); the paired grad op below
@@ -147,14 +171,22 @@ def _sdpa_grad(ins, attrs, rng=None):
     out, lse = _x(ins, "Out"), _x(ins, "Lse")
     g = _x(ins, "GRAD::Out")
     scale, drop, seed, use_pallas = _sdpa_config(ins, attrs, rng)
+    bthd = attrs.get("layout", "bhtd") == "bthd"
     from paddle_tpu.parallel import flash_attention as fa
 
-    ring = _ring_config(q, k, drop)
+    t_axis = 1 if bthd else 2
+    ring = _ring_config_t(q, k, drop, t_axis)
     if ring is not None:
         mesh, ctx_axis, data_axis = ring
         from paddle_tpu.parallel import ring_attention as ra
 
         def f(q, k, v):
+            if bthd:
+                o = ra.ring_attention(
+                    jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                    jnp.swapaxes(v, 1, 2), mesh, seq_axis=ctx_axis,
+                    scale=scale, bias=bias, data_axis=data_axis)
+                return jnp.swapaxes(o, 1, 2)
             return ra.ring_attention(
                 q, k, v, mesh, seq_axis=ctx_axis, scale=scale, bias=bias,
                 data_axis=data_axis,
@@ -162,6 +194,20 @@ def _sdpa_grad(ins, attrs, rng=None):
 
         _, vjp = jax.vjp(f, q, k, v)
         dq, dk, dv = vjp(g.astype(q.dtype))
+    elif bthd:
+        if use_pallas:
+            dq, dk, dv = fa.flash_attention_bthd_bwd(
+                q, k, v, bias, seed, out, lse, g.astype(q.dtype),
+                scale=scale, p_drop=drop)
+        else:
+            sd = seed if drop > 0.0 else None
+
+            def f(q, k, v):
+                return fa._reference_attention_bthd(
+                    q, k, v, bias, scale, drop, sd).astype(q.dtype)
+
+            _, vjp = jax.vjp(f, q, k, v)
+            dq, dk, dv = vjp(g.astype(q.dtype))
     elif use_pallas:
         # gates internally between the blocked Pallas kernels and a vjp of
         # the same dense composition the forward used — one source of truth

@@ -579,3 +579,393 @@ def _fa_lse_vjp_bwd(scale, p_drop, q_block, k_block, res, gs):
 
 flash_attention_with_lse.defvjp(_fa_lse_vjp_fwd, _fa_lse_vjp_bwd)
 
+
+
+# ---------------------------------------------------------------------------
+# BTHD fast path: q/k/v in [b, t, h, dh] — the layout the attention
+# projections naturally produce (reshape of [b, t, d]; no head transpose).
+# Profiling the transformer bench showed the BHTD kernels cost ~15 ms/step
+# in pure layout copies: XLA must re-lay-out every custom-call operand
+# around the [b, h, t, dh] contract, and the b-sized grid pays ~5 us fixed
+# cost per program. Here the whole (tq, tk) score fits one kernel program
+# (single-block, no online softmax carry) and `bb` batch elements share
+# one program, so t <= ~512 runs with 8-32x fewer program invocations and
+# zero operand re-layouts. Longer sequences fall back to the K-blocked
+# BHTD kernels (one transpose pair) or, beyond that, ring attention.
+# ---------------------------------------------------------------------------
+
+_SMALL_T_MAX = 512
+
+
+def _use_bthd_small(tq, tk, bq=None, bk=None):
+    return (
+        (jax.default_backend() == "tpu" or _INTERPRET)
+        and 8 <= tq <= _SMALL_T_MAX
+        and 8 <= tk <= _SMALL_T_MAX
+        # tq is walked in _CQ-row grid steps: a non-dividing tq would
+        # truncate nq = tq // cq and leave the tail rows unwritten
+        and (tq <= _CQ or tq % _CQ == 0)
+    )
+
+
+def _small_dropout(seed_ref, i, jc, hi, shape, p_drop):
+    """Scaled keep mask for (batch i, q-chunk jc, head hi) — keyed
+    absolutely so the forward and backward kernels (same _CQ chunking of
+    tq, same per-head loop) regenerate identical streams. bf16 mask; the
+    bf16 rounding of 1/p_keep (~0.2%) shifts the inverted-dropout scale
+    identically in both directions, so gradients stay exact for the
+    actual forward."""
+    pltpu.prng_seed(_block_seed(seed_ref[0], i, jc, hi))
+    p_keep = 1.0 - p_drop
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    thresh = jnp.uint32(int(p_keep * float(2**32 - 1)))
+    return (bits < thresh).astype(jnp.bfloat16) * jnp.bfloat16(1.0 / p_keep)
+
+
+# Fixed q-chunk for the single-block kernels: tq is walked in _CQ-row grid
+# steps with the full tk resident per program (k/v block indices don't
+# change with the chunk index, so Pallas skips their re-fetch). Inside a
+# program everything is 2-D: heads are LANE slices of the (t, h*dh) view
+# (a free minor-dims reshape of the [b, t, h, dh] block), so the kernels
+# contain NO vector transposes — Mosaic lowers major-dim transposes to
+# element shuffles that measured 4x slower than the whole attention op.
+_CQ = 128
+
+
+def _head(x2, hi, dh):
+    return x2[:, hi * dh:(hi + 1) * dh]   # lane slice: (t, dh)
+
+
+def _scores_head(q2, k2, hi, dh, scale, bias_ref, hb):
+    s = jax.lax.dot_general(
+        _head(q2, hi, dh), _head(k2, hi, dh), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                              # (cq, tk)
+    if bias_ref is not None:
+        b2 = bias_ref[0, min(hi, hb - 1)]  # (1|cq, tk)
+        s = s + b2.astype(jnp.float32)
+    return s
+
+
+def _fwd_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
+                      lse_ref, *, scale, p_drop, h, dh, hb):
+    i, j = pl.program_id(0), pl.program_id(1)
+    q2, k2, v2 = q_ref[0], k_ref[0], v_ref[0]   # (cq|tk, h*dh)
+    outs, lses = [], []
+    for hi in range(h):
+        s = _scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        lses.append(m + jnp.log(l))        # (cq, 1)
+        if p_drop > 0.0:
+            p = p * _small_dropout(seed_ref, i, j, hi, p.shape, p_drop)
+        o = jax.lax.dot_general(
+            p.astype(v2.dtype), _head(v2, hi, dh), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) / l                              # (cq, dh)
+        outs.append(o.astype(o_ref.dtype))
+    o_ref[0] = jnp.concatenate(outs, axis=-1)       # (cq, h*dh)
+    lse_ref[0] = jnp.concatenate(lses, axis=-1)     # (cq, h)
+
+
+def _dq_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                     lse_ref, delta_ref, dq_ref, *, scale, p_drop, h, dh,
+                     hb):
+    i, j = pl.program_id(0), pl.program_id(1)
+    q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    lse2, delta2 = lse_ref[0], delta_ref[0]         # (cq, h)
+    dqs = []
+    for hi in range(h):
+        s = _scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
+        p = jnp.exp(s - lse2[:, hi:hi + 1])
+        dp = jax.lax.dot_general(
+            _head(do2, hi, dh), _head(v2, hi, dh), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                   # (cq, tk)
+        if p_drop > 0.0:
+            dp = dp * _small_dropout(seed_ref, i, j, hi, dp.shape, p_drop)
+        ds = p * (dp - delta2[:, hi:hi + 1]) * scale
+        dq = jax.lax.dot_general(
+            ds.astype(k2.dtype), _head(k2, hi, dh), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                   # (cq, dh)
+        dqs.append(dq.astype(dq_ref.dtype))
+    dq_ref[0] = jnp.concatenate(dqs, axis=-1)       # (cq, h*dh)
+
+
+def _dkv_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                      lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                      *, scale, p_drop, nq, h, dh, hb):
+    i, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    lse2, delta2 = lse_ref[0], delta_ref[0]         # (cq, h)
+    for hi in range(h):
+        s = _scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
+        p = jnp.exp(s - lse2[:, hi:hi + 1])
+        if p_drop > 0.0:
+            drop = _small_dropout(seed_ref, i, j, hi, p.shape, p_drop)
+            pd = p * drop
+        else:
+            pd = p
+        # dv_h += pd^T @ do_h : (tk, cq) x (cq, dh)
+        dv_scr[:, hi * dh:(hi + 1) * dh] += jax.lax.dot_general(
+            pd.astype(do2.dtype), _head(do2, hi, dh),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            _head(do2, hi, dh), _head(v2, hi, dh), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if p_drop > 0.0:
+            dp = dp * drop
+        ds = p * (dp - delta2[:, hi:hi + 1]) * scale
+        # dk_h += ds^T @ q_h : (tk, cq) x (cq, dh)
+        dk_scr[:, hi * dh:(hi + 1) * dh] += jax.lax.dot_general(
+            ds.astype(q2.dtype), _head(q2, hi, dh), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bias_spec_bthd(bias, cq, tk):
+    hb, tq_b = bias.shape[1], bias.shape[2]
+    if tq_b == 1:
+        return pl.BlockSpec((1, hb, 1, tk), lambda i, j, *_: (i, 0, 0, 0))
+    return pl.BlockSpec((1, hb, cq, tk), lambda i, j, *_: (i, 0, j, 0))
+
+
+def _reference_attention_bthd(q, k, v, bias, scale, p_drop=0.0, seed=None):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(s.dtype)
+    p = jax.nn.softmax(s, axis=-1)
+    if p_drop > 0.0:
+        key = jax.random.PRNGKey(0 if seed is None else jnp.asarray(seed))
+        keep = jax.random.bernoulli(key, 1.0 - p_drop, p.shape)
+        p = jnp.where(keep, p / (1.0 - p_drop), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def flash_attention_bthd_fwd(q, k, v, bias=None, seed=None, scale=None,
+                             p_drop: float = 0.0):
+    """q [b, tq, h, dh], k/v [b, tk, h, dh] -> (out [b, tq, h, dh],
+    lse [b, tq, h, 1] f32; zeros on the dense fallback)."""
+    if p_drop > 0.0 and seed is None:
+        raise ValueError("flash_attention: p_drop > 0 requires `seed`")
+    b, tq, h, dh = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(dh)
+    if not _use_bthd_small(tq, tk):
+        if (jax.default_backend() == "tpu" or _INTERPRET) and tk > _SMALL_T_MAX:
+            # long context: one transpose pair into the K-blocked kernels
+            out, lse = flash_attention_fwd(
+                jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                jnp.swapaxes(v, 1, 2), bias, seed, scale, p_drop)
+            return jnp.swapaxes(out, 1, 2), jnp.swapaxes(lse, 1, 2)
+        out = _reference_attention_bthd(q, k, v, bias, scale, p_drop,
+                                        seed if p_drop > 0.0 else None)
+        return out, jnp.zeros((b, tq, h, 1), jnp.float32)
+
+    cq = min(tq, _CQ)
+    nq = tq // cq
+    hdh = h * dh
+    in_specs = [
+        pl.BlockSpec((1, cq, hdh), lambda i, j, *_: (i, j, 0)),
+        pl.BlockSpec((1, tk, hdh), lambda i, j, *_: (i, 0, 0)),
+        pl.BlockSpec((1, tk, hdh), lambda i, j, *_: (i, 0, 0)),
+    ]
+    args = [q.reshape(b, tq, hdh), k.reshape(b, tk, hdh),
+            v.reshape(b, tk, hdh)]
+    hb = 1 if bias is None else bias.shape[1]
+    if bias is not None:
+        in_specs.append(_bias_spec_bthd(bias, cq, tk))
+        args.append(bias)
+        kernel = functools.partial(_fwd_small_kernel, scale=scale,
+                                   p_drop=p_drop, h=h, dh=dh, hb=hb)
+    else:
+        kernel = functools.partial(
+            lambda sr, qr, kr, vr, orf, lr, **kw: _fwd_small_kernel(
+                sr, qr, kr, vr, None, orf, lr, **kw),
+            scale=scale, p_drop=p_drop, h=h, dh=dh, hb=hb,
+        )
+    out2, lse2 = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, nq),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, cq, hdh), lambda i, j, *_: (i, j, 0)),
+                pl.BlockSpec((1, cq, h), lambda i, j, *_: (i, j, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, tq, hdh), q.dtype),
+            jax.ShapeDtypeStruct((b, tq, h), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(_seed_arr(seed), *args)
+    return out2.reshape(b, tq, h, dh), lse2[..., None]
+
+
+def flash_attention_bthd_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
+                             p_drop: float = 0.0):
+    """-> (dq, dk, dv) in [b, t, h, dh], consuming the forward's saved
+    (out, lse)."""
+    b, tq, h, dh = q.shape
+    tk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(dh)
+    if not _use_bthd_small(tq, tk):
+        if (jax.default_backend() == "tpu" or _INTERPRET) and tk > _SMALL_T_MAX:
+            dq, dk, dv = flash_attention_bwd(
+                jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                jnp.swapaxes(v, 1, 2), bias, seed,
+                jnp.swapaxes(out, 1, 2), jnp.swapaxes(lse, 1, 2),
+                jnp.swapaxes(g, 1, 2), scale, p_drop)
+            return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+                    jnp.swapaxes(dv, 1, 2))
+
+        def f(q, k, v):
+            return _reference_attention_bthd(
+                q, k, v, bias, scale, p_drop,
+                seed if p_drop > 0.0 else None)
+
+        _, vjp = jax.vjp(f, q, k, v)
+        return vjp(g)
+
+    cq = min(tq, _CQ)
+    nq = tq // cq
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)         # [b, tq, h, 1]
+    hdh = h * dh
+    base_specs = [
+        pl.BlockSpec((1, cq, hdh), lambda i, j, *_: (i, j, 0)),   # q
+        pl.BlockSpec((1, tk, hdh), lambda i, j, *_: (i, 0, 0)),   # k
+        pl.BlockSpec((1, tk, hdh), lambda i, j, *_: (i, 0, 0)),   # v
+    ]
+    base_args = [q.reshape(b, tq, hdh), k.reshape(b, tk, hdh),
+                 v.reshape(b, tk, hdh)]
+    if bias is not None:
+        base_specs = base_specs + [_bias_spec_bthd(bias, cq, tk)]
+        base_args = base_args + [bias]
+    tail_specs = [
+        pl.BlockSpec((1, cq, hdh), lambda i, j, *_: (i, j, 0)),   # do
+        pl.BlockSpec((1, cq, h), lambda i, j, *_: (i, j, 0)),     # lse
+        pl.BlockSpec((1, cq, h), lambda i, j, *_: (i, j, 0)),     # delta
+    ]
+    tail_args = [g.reshape(b, tq, hdh), lse[..., 0], delta[..., 0]]
+
+    hb = 1 if bias is None else bias.shape[1]
+    if bias is not None:
+        dq_kernel = functools.partial(_dq_small_kernel, scale=scale,
+                                      p_drop=p_drop, h=h, dh=dh, hb=hb)
+        dkv_kernel = functools.partial(_dkv_small_kernel, scale=scale,
+                                       p_drop=p_drop, nq=nq, h=h, dh=dh,
+                                       hb=hb)
+    else:
+        dq_kernel = functools.partial(
+            lambda sr, qr, kr, vr, dor, lr, der, dqr, **kw:
+                _dq_small_kernel(sr, qr, kr, vr, None, dor, lr, der, dqr,
+                                 **kw),
+            scale=scale, p_drop=p_drop, h=h, dh=dh, hb=hb,
+        )
+        dkv_kernel = functools.partial(
+            lambda sr, qr, kr, vr, dor, lr, der, dkr, dvr, dks, dvs, **kw:
+                _dkv_small_kernel(sr, qr, kr, vr, None, dor, lr, der,
+                                  dkr, dvr, dks, dvs, **kw),
+            scale=scale, p_drop=p_drop, nq=nq, h=h, dh=dh, hb=hb,
+        )
+
+    dq2 = pl.pallas_call(
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, nq),
+            in_specs=base_specs + tail_specs,
+            out_specs=pl.BlockSpec((1, cq, hdh), lambda i, j, *_: (i, j, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, tq, hdh), q.dtype),
+        interpret=_INTERPRET,
+    )(_seed_arr(seed), *base_args, *tail_args)
+
+    dk2, dv2 = pl.pallas_call(
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, nq),
+            in_specs=base_specs + tail_specs,
+            out_specs=[
+                pl.BlockSpec((1, tk, hdh), lambda i, j, *_: (i, 0, 0)),
+                pl.BlockSpec((1, tk, hdh), lambda i, j, *_: (i, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((tk, hdh), jnp.float32),
+                pltpu.VMEM((tk, hdh), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, tk, hdh), k.dtype),
+            jax.ShapeDtypeStruct((b, tk, hdh), v.dtype),
+        ],
+        interpret=_INTERPRET,
+    )(_seed_arr(seed), *base_args, *tail_args)
+    return (dq2.reshape(b, tq, h, dh), dk2.reshape(b, tk, h, dh),
+            dv2.reshape(b, tk, h, dh))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def flash_attention_bthd_with_lse(q, k, v, bias=None, seed=None,
+                                  scale: Optional[float] = None,
+                                  p_drop: float = 0.0):
+    """(out, lse) in BTHD with a custom vjp over the single-block kernels
+    (pallas_call has no JVP rule); the paired sdpa grad op uses the _bwd
+    entry directly with the saved stats."""
+    return flash_attention_bthd_fwd(q, k, v, bias, seed, scale, p_drop)
+
+
+def _bthd_vjp_fwd(q, k, v, bias, seed, scale, p_drop):
+    out, lse = flash_attention_bthd_fwd(q, k, v, bias, seed, scale, p_drop)
+    return (out, lse), (q, k, v, bias, seed, out, lse)
+
+
+def _bthd_vjp_bwd(scale, p_drop, res, gs):
+    g, _g_lse = gs
+    q, k, v, bias, seed, out, lse = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if _use_bthd_small(q.shape[1], k.shape[1]) or k.shape[1] > _SMALL_T_MAX:
+        dq, dk, dv = flash_attention_bthd_bwd(
+            q, k, v, bias, seed, out, lse, g.astype(q.dtype), scale, p_drop)
+        dbias = None if bias is None else jnp.zeros_like(bias)
+    else:
+        sd = seed if p_drop > 0.0 else None
+        if bias is None:
+            _, vjp = jax.vjp(
+                lambda a, b, c: _reference_attention_bthd(
+                    a, b, c, None, scale, p_drop, sd), q, k, v)
+            dq, dk, dv = vjp(g.astype(q.dtype))
+            dbias = None
+        else:
+            _, vjp = jax.vjp(
+                lambda a, b, c, bb_: _reference_attention_bthd(
+                    a, b, c, bb_, scale, p_drop, sd), q, k, v, bias)
+            dq, dk, dv, dbias = vjp(g.astype(q.dtype))
+    return dq, dk, dv, dbias, _seed_cotangent(seed)
+
+
+flash_attention_bthd_with_lse.defvjp(_bthd_vjp_fwd, _bthd_vjp_bwd)

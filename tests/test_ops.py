@@ -332,3 +332,22 @@ def test_gru_unit_op():
     expected = u * hp + (1 - u) * c
     h.check_output({"Hidden": expected})
     h.check_grad(["input_0", "hiddenprev_0", "weight_0", "bias_0"])
+
+
+def test_dropout_prob_zero_is_identity_in_train_mode():
+    """p=0 must not overflow the uint16 keep threshold (regression)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        y = layers.dropout(x, 0.0, is_test=False,
+                           dropout_implementation="upscale_in_train")
+        loss = layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xa = np.random.RandomState(0).normal(0, 1, (4, 8)).astype(np.float32)
+    out = exe.run(main, feed={"x": xa}, fetch_list=[y.name])
+    np.testing.assert_allclose(np.asarray(out[0]), xa, rtol=1e-6)
