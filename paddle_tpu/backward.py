@@ -71,6 +71,19 @@ def append_backward(
         if not lst:
             return None
         if len(lst) > 1 and g not in finalized:
+            # A row-sparse marker among the partials cannot be summed with
+            # dense partials (its array is never materialized). Catches the
+            # ordering the sparse grad maker's own @RENAME check misses —
+            # the sparse lookup claiming the clean name first.
+            for n in lst:
+                v = block._find_var_recursive(n)
+                if v is not None and getattr(v, "is_selected_rows", False):
+                    raise ValueError(
+                        f"parameter '{var_name}' has both a row-sparse "
+                        f"gradient (is_sparse=True lookup) and other dense "
+                        f"gradient contributions; they cannot be combined. "
+                        f"Use is_sparse=False for this table."
+                    )
             # Combine partial gradients (reference: backward.py:135).
             block.create_var(name=g, dtype=_var_dtype(var_name))
             block.append_op("sum", inputs={"X": list(lst)}, outputs={"Out": g})

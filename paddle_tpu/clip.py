@@ -91,6 +91,10 @@ def set_gradient_clip(clip: BaseGradientClipAttr, param_list=None, program=None)
         }
 
 
+def has_clip_attr() -> bool:
+    return _clip_attr is not None
+
+
 def append_gradient_clip_ops(params_grads):
     if _clip_attr is None:
         return params_grads

@@ -120,6 +120,11 @@ def embedding(
         # Marks the table for row-sharded lookup (psum over the strategy's
         # table axis) when run under CompiledProgram.with_strategy.
         attrs["is_distributed"] = True
+    if is_sparse:
+        # Row-sparse {rows, values} gradient pair instead of a dense
+        # [V, D] scatter-add (the reference's SelectedRows); consumed by
+        # the *_sparse optimizer ops. See ops/sparse_ops.py.
+        attrs["is_sparse"] = True
     helper.append_op(
         "lookup_table",
         inputs={"W": w, "Ids": input},

@@ -36,11 +36,17 @@ class DeepFMConfig:
         self.hidden = tuple(hidden)
 
 
-def build(cfg: Optional[DeepFMConfig] = None, is_distributed: bool = True):
+def build(cfg: Optional[DeepFMConfig] = None, is_distributed: bool = True,
+          is_sparse: bool = True):
     """Builds the DeepFM graph in the current program.
 
     Feeds: feat_ids [b, F] int64 (one id per field), label [b, 1] f32.
     Returns {"feeds", "loss", "logit", "config"}.
+
+    ``is_sparse``: row-sparse {rows, values} embedding gradients + lazy
+    per-row optimizer updates instead of dense [V, D] scatter-adds — the
+    CTR-scale capability the reference served with SelectedRows
+    (ops/sparse_ops.py).
     """
     cfg = cfg or DeepFMConfig()
     f, k = cfg.num_fields, cfg.embed_dim
@@ -50,6 +56,7 @@ def build(cfg: Optional[DeepFMConfig] = None, is_distributed: bool = True):
     # first-order weights: [V, 1] table
     w1 = layers.embedding(
         ids, size=[cfg.vocab_size, 1], is_distributed=is_distributed,
+        is_sparse=is_sparse,
         param_attr=ParamAttr(name="deepfm_first.w"),
     )  # [b, F, 1]
     first = layers.reduce_sum(w1, dim=1)  # [b, 1]
@@ -57,6 +64,7 @@ def build(cfg: Optional[DeepFMConfig] = None, is_distributed: bool = True):
     # second-order factor table: [V, K]
     emb = layers.embedding(
         ids, size=[cfg.vocab_size, k], is_distributed=is_distributed,
+        is_sparse=is_sparse,
         param_attr=ParamAttr(name="deepfm_factor.w"),
     )  # [b, F, K]
     summed = layers.reduce_sum(emb, dim=1)  # [b, K]

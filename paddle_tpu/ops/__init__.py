@@ -19,5 +19,6 @@ from paddle_tpu.ops import (  # noqa: F401
     optimizer_ops,
     rnn_ops,
     sequence_ops,
+    sparse_ops,
     tensor_ops,
 )

@@ -1,0 +1,138 @@
+"""Row-sparse gradients — the TPU-native SelectedRows equivalent.
+
+The reference's lookup_table grad is a SelectedRows {rows, values} pair and
+its optimizers apply row-wise updates (reference: framework/selected_rows.h:32,
+operators/lookup_table_op.cc grad kernel, math/selected_rows_functor.cc
+MergeAdd, optimizers/adam_op.h lazy mode). Here the pair is two ordinary
+IR variables (``{W}@GRAD@ROWS`` int32, ``{W}@GRAD@VALUES`` [n, D]) produced
+by ``lookup_table_sparse_grad`` when the embedding is built with
+``is_sparse=True``; sparse optimizer ops consume them and update ONLY the
+touched rows with XLA scatters into the donated parameter buffer — the
+dense [V, D] gradient never exists in HBM, which is the point for CTR-scale
+vocabularies.
+
+Static-shape discipline (XLA): duplicate ids are NOT deduped by resizing.
+``_merge_rows`` sorts ids, segment-sums duplicate rows' values into their
+first slot, and marks the other slots with an out-of-range sentinel row that
+``mode='drop'`` scatters ignore — the reference's MergeAdd with fixed
+shapes. Linear updates (plain SGD) skip the merge: scatter-add over
+duplicates is already correct.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _g(ins, slot):
+    v = ins.get(slot)
+    return v[0] if v else None
+
+
+@register_op("lookup_table_sparse_grad", no_grad=True)
+def _lookup_table_sparse_grad(ins, attrs):
+    """(Ids, dOut) -> (Rows [n] int32, Values [n, D]).
+
+    Padding rows get the ``vocab_size`` sentinel (dropped by the sparse
+    optimizer scatters), mirroring the dense path's padding_idx zeroing.
+    """
+    ids, g = _g(ins, "Ids"), _g(ins, "GRAD::Out")
+    vocab = int(attrs["vocab_size"])
+    squeeze_last = attrs.get(
+        "squeeze_last", jnp.ndim(ids) > 1 and jnp.shape(ids)[-1] == 1
+    )
+    if squeeze_last:
+        ids = jnp.squeeze(ids, axis=-1)
+    rows = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+    rows = jnp.where(rows < 0, rows + vocab, rows)
+    d = jnp.shape(g)[-1]
+    values = jnp.reshape(g, (-1, d))
+    padding_idx = attrs.get("padding_idx", None)
+    if padding_idx is not None:
+        if padding_idx < 0:
+            padding_idx = vocab + padding_idx
+        rows = jnp.where(rows == padding_idx, vocab, rows)
+    return {"Rows": [rows], "Values": [values]}
+
+
+def _merge_rows(rows, values, vocab):
+    """Sum duplicate rows' values into one slot each (reference:
+    math/selected_rows_functor.cc MergeAdd), keeping [n] static shapes:
+    non-first duplicate slots get the ``vocab`` sentinel row and zero
+    values, so drop-mode scatters skip them."""
+    order = jnp.argsort(rows)
+    r = rows[order]
+    v = values[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), r[1:] != r[:-1]]
+    )
+    seg = jnp.cumsum(first) - 1                      # [n] segment index
+    merged_v = jax.ops.segment_sum(v, seg, num_segments=rows.shape[0])
+    merged_r = jnp.full_like(r, vocab)
+    merged_r = merged_r.at[seg].set(r)               # same id per segment
+    # sentinel rows (slots past the last segment, or padding already at
+    # ``vocab``) are dropped by the consumer's scatter
+    return merged_r, merged_v
+
+
+@register_op("sgd_sparse", no_grad=True)
+def _sgd_sparse(ins, attrs):
+    p = _g(ins, "Param")
+    rows, values = _g(ins, "Rows"), _g(ins, "Values")
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    # linear update: scatter-add over duplicate rows is already the sum
+    upd = (-lr) * values.astype(p.dtype)
+    return {"ParamOut": [p.at[rows].add(upd, mode="drop")]}
+
+
+@register_op("momentum_sparse", no_grad=True)
+def _momentum_sparse(ins, attrs):
+    p, v = _g(ins, "Param"), _g(ins, "Velocity")
+    rows, values = _g(ins, "Rows"), _g(ins, "Values")
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    mu = attrs.get("mu", 0.9)
+    vocab = p.shape[0]
+    rows_m, g_m = _merge_rows(rows, values.astype(p.dtype), vocab)
+    safe = jnp.clip(rows_m, 0, vocab - 1)
+    v_rows = mu * v[safe] + g_m
+    v_new = v.at[rows_m].set(v_rows, mode="drop")
+    if attrs.get("use_nesterov", False):
+        step = (g_m + mu * v_rows) * lr
+    else:
+        step = lr * v_rows
+    p_new = p.at[rows_m].add(-step, mode="drop")
+    return {"ParamOut": [p_new], "VelocityOut": [v_new]}
+
+
+@register_op("adam_sparse", no_grad=True)
+def _adam_sparse(ins, attrs):
+    """Lazy Adam on the touched rows only (reference: adam_op.h lazy_mode;
+    Paddle's LazyAdam semantics — untouched rows' moments do not decay)."""
+    p = _g(ins, "Param")
+    m1, m2 = _g(ins, "Moment1"), _g(ins, "Moment2")
+    b1p, b2p = _g(ins, "Beta1Pow"), _g(ins, "Beta2Pow")
+    rows, values = _g(ins, "Rows"), _g(ins, "Values")
+    lr = _g(ins, "LearningRate").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    vocab = p.shape[0]
+    rows_m, g_m = _merge_rows(rows, values.astype(m1.dtype), vocab)
+    safe = jnp.clip(rows_m, 0, vocab - 1)
+    m1_r = b1 * m1[safe] + (1 - b1) * g_m
+    m2_r = b2 * m2[safe] + (1 - b2) * jnp.square(g_m)
+    b1pn, b2pn = b1p * b1, b2p * b2
+    lr_t = lr * jnp.sqrt(1 - b2pn.reshape(())) / (1 - b1pn.reshape(()))
+    upd = lr_t.astype(p.dtype) * (
+        m1_r / (jnp.sqrt(m2_r) + eps)
+    ).astype(p.dtype)
+    return {
+        "ParamOut": [p.at[rows_m].add(-upd, mode="drop")],
+        "Moment1Out": [m1.at[rows_m].set(m1_r, mode="drop")],
+        "Moment2Out": [m2.at[rows_m].set(m2_r, mode="drop")],
+        "Beta1PowOut": [b1pn],
+        "Beta2PowOut": [b2pn],
+    }

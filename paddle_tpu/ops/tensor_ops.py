@@ -213,10 +213,77 @@ def _one_hot(ins, attrs):
     return {"Out": [jax.nn.one_hot(x, depth, dtype=attrs.get("dtype", "float32"))]}
 
 
+def _lookup_table_grad_maker(op, block, out_grads, provide, should_skip):
+    """Emit the row-sparse grad pair when the layer asked for
+    ``is_sparse=True`` (the SelectedRows capability, reference:
+    lookup_table_op.cc grad -> SelectedRows); otherwise the standard dense
+    auto-vjp grad desc. The sparse pair is two IR vars named
+    ``{W}@GRAD@ROWS`` / ``{W}@GRAD@VALUES``; the ``{W}@GRAD`` variable
+    itself becomes a never-materialized marker carrying
+    ``is_selected_rows`` so the optimizer dispatches to its sparse op."""
+    from paddle_tpu.core.registry import get_op_def
+
+    w = op.inputs["W"][0]
+    g_out = (out_grads.get("Out") or [""])[0]
+    if not g_out:
+        return []
+    opdef = get_op_def("lookup_table")
+    if should_skip(w, "W", opdef):
+        return []
+    src = block._find_var_recursive(w)
+    gname = provide(w)
+    if not op.attrs.get("is_sparse", False):
+        block.create_var(name=gname, shape=src.shape if src else None,
+                         dtype=src.dtype if src else "float32")
+        g_inputs = dict(op.inputs)
+        for slot, names in op.outputs.items():
+            g_inputs.setdefault(slot, names)
+        g_inputs["GRAD::Out"] = [g_out]
+        attrs = dict(op.attrs)
+        attrs["fwd_input_slots"] = list(op.inputs.keys())
+        attrs["fwd_output_slots"] = list(op.outputs.keys())
+        return [dict(
+            type="lookup_table_grad",
+            inputs=g_inputs,
+            outputs={"GRAD::W": [gname], "GRAD::Ids": [""]},
+            attrs=attrs,
+        )]
+    if "@RENAME@" in gname:
+        raise ValueError(
+            f"lookup_table(is_sparse=True): table '{w}' is consumed by "
+            f"multiple lookups in the backward path; the row-sparse "
+            f"gradient pair cannot be summed. Use is_sparse=False for "
+            f"shared tables."
+        )
+    gv = block.create_var(name=gname, shape=src.shape if src else None,
+                          dtype=src.dtype if src else "float32")
+    rows_name, values_name = gname + "@ROWS", gname + "@VALUES"
+    block.create_var(name=rows_name, dtype="int32")
+    block.create_var(name=values_name,
+                     dtype=src.dtype if src else "float32")
+    gv.is_selected_rows = True
+    gv.sparse_rows_name = rows_name
+    gv.sparse_values_name = values_name
+    attrs = {"vocab_size": int(src.shape[0])}
+    # mirror the forward's squeeze behavior exactly (dynamic default when
+    # the layer didn't pin it)
+    if "squeeze_last" in op.attrs:
+        attrs["squeeze_last"] = op.attrs["squeeze_last"]
+    if "padding_idx" in op.attrs:
+        attrs["padding_idx"] = op.attrs["padding_idx"]
+    return [dict(
+        type="lookup_table_sparse_grad",
+        inputs={"Ids": list(op.inputs["Ids"]), "GRAD::Out": [g_out]},
+        outputs={"Rows": [rows_name], "Values": [values_name]},
+        attrs=attrs,
+    )]
+
+
 @register_op("lookup_table", diff_inputs=("W",),
-             doc="embedding lookup; dense scatter-add grad on TPU replaces "
-                 "the reference's SelectedRows sparse grad "
-                 "(lookup_table_op.cc)")
+             grad_maker=_lookup_table_grad_maker,
+             doc="embedding lookup; grad is a dense XLA scatter-add, or a "
+                 "row-sparse {rows, values} pair under is_sparse=True "
+                 "(the reference's SelectedRows, lookup_table_op.cc)")
 def _lookup_table(ins, attrs):
     w, ids = _x(ins, "W"), _x(ins, "Ids")
     # [N, 1] column-ids convention: squeeze unless the layer says the ids

@@ -105,17 +105,51 @@ class Optimizer:
         from paddle_tpu import clip as clip_mod
         from paddle_tpu import regularizer as reg_mod
 
-        params_grads = clip_mod.append_gradient_clip_ops(params_grads)
-        params_grads = reg_mod.append_regularization_ops(
-            params_grads, self.regularization
+        # Row-sparse (SelectedRows-style) grads bypass clip/regularization
+        # and dispatch to the optimizer's sparse op. Silently skipping
+        # user-REQUESTED decay/clipping would also skew a global-norm clip
+        # (computed over dense grads only), so that combination errors out
+        # instead.
+        sparse = [(p, g) for p, g in params_grads
+                  if getattr(g, "is_selected_rows", False)]
+        dense = [(p, g) for p, g in params_grads
+                 if not getattr(g, "is_selected_rows", False)]
+        for p, _ in sparse:
+            if self.regularization is not None or \
+                    getattr(p, "regularizer", None) is not None:
+                raise NotImplementedError(
+                    f"regularization on row-sparse parameter '{p.name}' is "
+                    f"not supported; use is_sparse=False for this embedding"
+                )
+            if clip_mod.has_clip_attr():
+                raise NotImplementedError(
+                    f"gradient clipping with row-sparse parameter "
+                    f"'{p.name}' is not supported (a global-norm clip over "
+                    f"dense grads only would under-clip); use "
+                    f"is_sparse=False"
+                )
+        dense = clip_mod.append_gradient_clip_ops(dense)
+        dense = reg_mod.append_regularization_ops(
+            dense, self.regularization
         )
+        params_grads = dense + sparse
 
         self._create_accumulators(block, [p for p, _ in params_grads])
         n_before = len(block.ops)
         for pg in params_grads:
-            self._append_optimize_op(block, pg)
+            if getattr(pg[1], "is_selected_rows", False):
+                self._append_sparse_optimize_op(block, pg)
+            else:
+                self._append_optimize_op(block, pg)
         self._finish_update(block, params_grads)
         return block.ops[n_before:]
+
+    def _append_sparse_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no row-sparse update op; use "
+            f"SGD/Momentum/Adam for is_sparse=True embeddings, or build "
+            f"the embedding with is_sparse=False"
+        )
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
@@ -257,6 +291,16 @@ class SGDOptimizer(Optimizer):
             outputs={"ParamOut": p.name},
         )
 
+    def _append_sparse_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        block.append_op(
+            "sgd_sparse",
+            inputs={"Param": p, "Rows": g.sparse_rows_name,
+                    "Values": g.sparse_values_name,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name},
+        )
+
 
 class MomentumOptimizer(Optimizer):
     def __init__(self, learning_rate, momentum, use_nesterov=False,
@@ -275,6 +319,18 @@ class MomentumOptimizer(Optimizer):
         block.append_op(
             "momentum",
             inputs={"Param": p, "Grad": g, "Velocity": v,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name, "VelocityOut": v.name},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+    def _append_sparse_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        block.append_op(
+            "momentum_sparse",
+            inputs={"Param": p, "Rows": g.sparse_rows_name,
+                    "Values": g.sparse_values_name, "Velocity": v,
                     "LearningRate": self._param_lr(p)},
             outputs={"ParamOut": p.name, "VelocityOut": v.name},
             attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
@@ -342,6 +398,26 @@ class AdamOptimizer(Optimizer):
                    "epsilon": self._epsilon, **self._extra_attrs()},
         )
 
+    def _append_sparse_optimize_op(self, block, param_and_grad):
+        # Lazy Adam on the touched rows (reference: adam_op.h lazy_mode)
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow", p)
+        b2p = self._get_accumulator("beta2_pow", p)
+        block.append_op(
+            "adam_sparse",
+            inputs={"Param": p, "Rows": g.sparse_rows_name,
+                    "Values": g.sparse_values_name, "Moment1": m1,
+                    "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name, "Moment1Out": m1.name,
+                     "Moment2Out": m2.name, "Beta1PowOut": b1p.name,
+                     "Beta2PowOut": b2p.name},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
 
 class AdamWOptimizer(AdamOptimizer):
     _op_type = "adamw"
@@ -355,6 +431,11 @@ class AdamWOptimizer(AdamOptimizer):
     def _extra_attrs(self):
         return {"weight_decay": self._weight_decay}
 
+    def _append_sparse_optimize_op(self, block, param_and_grad):
+        # inheriting adam_sparse would silently drop the decoupled decay
+        return Optimizer._append_sparse_optimize_op(
+            self, block, param_and_grad)
+
 
 class LambOptimizer(AdamOptimizer):
     _op_type = "lamb"
@@ -367,6 +448,11 @@ class LambOptimizer(AdamOptimizer):
 
     def _extra_attrs(self):
         return {"weight_decay": self._weight_decay}
+
+    def _append_sparse_optimize_op(self, block, param_and_grad):
+        # inheriting adam_sparse would silently drop the trust-ratio rule
+        return Optimizer._append_sparse_optimize_op(
+            self, block, param_and_grad)
 
 
 class AdagradOptimizer(Optimizer):

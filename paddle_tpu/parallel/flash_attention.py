@@ -597,7 +597,7 @@ flash_attention_with_lse.defvjp(_fa_lse_vjp_fwd, _fa_lse_vjp_bwd)
 _SMALL_T_MAX = 512
 
 
-def _use_bthd_small(tq, tk, bq=None, bk=None):
+def _use_bthd_small(tq, tk):
     return (
         (jax.default_backend() == "tpu" or _INTERPRET)
         and 8 <= tq <= _SMALL_T_MAX
