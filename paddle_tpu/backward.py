@@ -138,9 +138,10 @@ def append_backward(
 
         if opdef.grad_maker is not None:
             descs = opdef.grad_maker(op, block, out_grads, provide, should_skip)
-            for d in descs:
-                block.append_op(**d)
-            continue
+            if descs is not None:  # None = defer to the generic emitter
+                for d in descs:
+                    block.append_op(**d)
+                continue
 
         g_inputs = dict(op.inputs)
         for slot, names in op.outputs.items():

@@ -95,6 +95,14 @@ def has_clip_attr() -> bool:
     return _clip_attr is not None
 
 
+def clip_applies_to(param_name: str) -> bool:
+    """Whether the installed gradient clip covers this parameter
+    (set_gradient_clip may scope to an explicit param_list)."""
+    if _clip_attr is None:
+        return False
+    return _clip_param_names is None or param_name in _clip_param_names
+
+
 def append_gradient_clip_ops(params_grads):
     if _clip_attr is None:
         return params_grads

@@ -25,4 +25,8 @@ from paddle_tpu.dygraph.nn import (  # noqa: F401
     Pool2D,
     PRelu,
 )
+from paddle_tpu.dygraph.parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+)
 from paddle_tpu.dygraph.tracer import Tracer, VarBase, get_tracer  # noqa: F401

@@ -1,0 +1,133 @@
+"""Eager data parallelism over the local device mesh.
+
+The TPU-native counterpart of the reference's dygraph ``DataParallel``
+(reference: python/paddle/fluid/dygraph/parallel.py:84), which wraps a
+Layer, scales the loss by trainer count, and all-reduces gradients over
+NCCL after ``backward()``. Here none of that choreography is manual:
+
+- parameters are placed REPLICATED over a ``jax.sharding.Mesh`` of the
+  local devices;
+- inputs are placed batch-sharded (``P('data')`` on dim 0);
+- every eager op then executes as an SPMD computation on the sharded
+  arrays, and the taped backward's parameter cotangents contract over the
+  sharded batch dimension — XLA inserts the all-reduce itself, so the
+  gradients arriving at the optimizer are already global and replicated.
+
+``scale_loss``/``apply_collective_grads`` are therefore identity
+operations kept for reference API compatibility (loss ops average over
+the GLOBAL batch here, unlike per-trainer local batches + summing
+all-reduce in the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.dygraph.layers import Layer
+from paddle_tpu.dygraph.tracer import VarBase
+
+
+class ParallelEnv:
+    """Reference-API shim (dygraph/parallel.py ParallelEnv): local rank /
+    world size of the eager data-parallel run. Single-process multi-device
+    on TPU, so rank is 0 and nranks is the device count."""
+
+    def __init__(self, mesh: Optional[Mesh] = None):
+        self.nranks = (
+            int(np.prod(list(mesh.shape.values()))) if mesh is not None
+            else jax.local_device_count()
+        )
+        self.local_rank = 0
+        self.dev_id = 0
+
+
+def _default_mesh(data_axis: str) -> Mesh:
+    devs = np.asarray(jax.devices())
+    return Mesh(devs, (data_axis,))
+
+
+class DataParallel(Layer):
+    """Wrap a dygraph Layer for multi-device eager training.
+
+    Usage (mirrors the reference)::
+
+        model = DataParallel(MLP())
+        loss = model(x, label)
+        loss = model.scale_loss(loss)       # identity, API parity
+        loss.backward()
+        model.apply_collective_grads()      # identity, API parity
+        optimizer.minimize(loss, parameter_list=model.parameters())
+    """
+
+    def __init__(self, layer: Layer, strategy=None,
+                 mesh: Optional[Mesh] = None, data_axis: str = "data"):
+        super().__init__()
+        self._layers = layer
+        self._data_axis = data_axis
+        self._mesh = mesh if mesh is not None else _default_mesh(data_axis)
+        self._env = ParallelEnv(self._mesh)
+        self._replicated = NamedSharding(self._mesh, P())
+        self._batch_sharded = NamedSharding(self._mesh, P(data_axis))
+        # replicate parameters across the mesh; optimizer updates preserve
+        # the placement (replicated op on replicated operands). Layers
+        # that build parameters lazily (FC on first forward) are re-placed
+        # after the first call — see forward().
+        self._placed = False
+        self._replicate_params()
+
+    def _replicate_params(self):
+        for p in self._layers.parameters():
+            p._value = jax.device_put(p._value, self._replicated)
+
+    # --- Layer surface delegates to the wrapped module ---
+
+    def parameters(self, include_sublayers: bool = True):
+        return self._layers.parameters(include_sublayers)
+
+    def sublayers(self, include_sublayers: bool = True):
+        return self._layers.sublayers(include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_dict(self, *a, **kw):
+        return self._layers.set_dict(*a, **kw)
+
+    load_dict = set_dict
+
+    def shard_input(self, value):
+        """Batch-shard an input (VarBase / ndarray) over the data axis."""
+        if isinstance(value, VarBase):
+            value._value = jax.device_put(value._value, self._batch_sharded)
+            return value
+        return VarBase(jax.device_put(np.asarray(value), self._batch_sharded),
+                       stop_gradient=True)
+
+    def forward(self, *inputs, **kwargs):
+        sharded = [
+            self.shard_input(x)
+            if isinstance(x, (VarBase, np.ndarray)) else x
+            for x in inputs
+        ]
+        out = self._layers(*sharded, **kwargs)
+        if not self._placed:
+            # lazily-built parameters (FC et al. materialize weights on
+            # their first call) now exist — pin them replicated
+            self._replicate_params()
+            self._placed = True
+        return out
+
+    def scale_loss(self, loss: VarBase) -> VarBase:
+        """Identity: losses here average over the GLOBAL sharded batch,
+        so no 1/nranks scaling is needed (reference scales because each
+        trainer averages only its local batch)."""
+        return loss
+
+    def apply_collective_grads(self):
+        """Identity: XLA already reduced the parameter cotangents across
+        the batch shards during backward."""
+        return None

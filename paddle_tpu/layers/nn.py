@@ -34,6 +34,8 @@ __all__ = [
     "mean_iou",
     "linear_chain_crf", "crf_decoding", "warpctc", "edit_distance",
     "bilinear_tensor_product", "nce", "switch_moe",
+    "roi_align", "roi_pool", "lrn", "spp", "affine_grid", "multiclass_nms",
+    "yolo_box", "sequence_conv", "add_position_encoding", "conv3d",
 ]
 
 
@@ -1254,3 +1256,140 @@ def switch_moe(input, num_experts, d_ff=None, capacity_factor=2.0,
         attrs={"capacity_factor": float(capacity_factor), "act": act},
     )
     return out, aux
+
+
+def _simple_op_layer(op_type, inputs, attrs=None, out_slot="Out",
+                     dtype=None, name=None, n_outs=1, out_slots=None):
+    helper = LayerHelper(op_type, name=name)
+    first = next(iter(inputs.values()))
+    base = first[0] if isinstance(first, (list, tuple)) else first
+    slots = out_slots or [out_slot]
+    outs = {
+        s: helper.create_variable_for_type_inference(
+            dtype=dtype or base.dtype)
+        for s in slots
+    }
+    helper.append_op(op_type, inputs=inputs, outputs=outs, attrs=attrs or {})
+    vals = [outs[s] for s in slots]
+    return vals[0] if len(vals) == 1 else tuple(vals)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    """Bilinear RoI align (reference: layers/nn.py roi_align)."""
+    return _simple_op_layer(
+        "roi_align", {"X": input, "ROIs": rois},
+        {"pooled_height": pooled_height, "pooled_width": pooled_width,
+         "spatial_scale": spatial_scale, "sampling_ratio": sampling_ratio},
+        name=name)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, name=None):
+    """Quantized max RoI pooling (reference: layers/nn.py roi_pool)."""
+    return _simple_op_layer(
+        "roi_pool", {"X": input, "ROIs": rois},
+        {"pooled_height": pooled_height, "pooled_width": pooled_width,
+         "spatial_scale": spatial_scale}, name=name)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    """Local response normalization (reference: layers/nn.py lrn)."""
+    return _simple_op_layer(
+        "lrn", {"X": input}, {"n": n, "k": k, "alpha": alpha, "beta": beta},
+        name=name)
+
+
+def spp(input, pyramid_height=3, pool_type="max", name=None):
+    """Spatial pyramid pooling (reference: layers/nn.py spp... via spp_op)."""
+    return _simple_op_layer(
+        "spp", {"X": input},
+        {"pyramid_height": pyramid_height, "pooling_type": pool_type},
+        name=name)
+
+
+def affine_grid(theta, out_shape, name=None):
+    """2-D affine sampling grid (reference: layers/nn.py affine_grid)."""
+    if isinstance(out_shape, (list, tuple)):
+        return _simple_op_layer(
+            "affine_grid", {"Theta": theta},
+            {"output_shape": [int(s) for s in out_shape]},
+            out_slot="Output", name=name)
+    return _simple_op_layer(
+        "affine_grid", {"Theta": theta, "OutputShape": out_shape},
+        out_slot="Output", name=name)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
+                   keep_top_k=16, nms_threshold=0.3, name=None):
+    """Static-shape multiclass NMS: [n, keep_top_k, 6] rows of
+    (label, score, box), label -1 padding (reference:
+    layers/detection.py multiclass_nms, LoD output redesigned away)."""
+    return _simple_op_layer(
+        "multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold},
+        name=name)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, name=None):
+    """YOLOv3 head decode (reference: layers/detection.py yolo_box)."""
+    return _simple_op_layer(
+        "yolo_box", {"X": x, "ImgSize": img_size},
+        {"anchors": list(anchors), "class_num": class_num,
+         "conf_thresh": conf_thresh, "downsample_ratio": downsample_ratio},
+        out_slots=["Boxes", "Scores"], name=name)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, param_attr=None, bias_attr=None, act=None,
+                  name=None):
+    """Context-window sequence convolution (reference: layers/nn.py
+    sequence_conv) on padded [b, t, d] batches."""
+    helper = LayerHelper("sequence_conv", name=name, bias_attr=bias_attr,
+                         act=act)
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        ParamAttr._to_attr(param_attr),
+        shape=[filter_size * d, num_filters], dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        "sequence_conv", inputs={"X": input, "Filter": w},
+        outputs={"Out": out},
+        attrs={"contextLength": filter_size,
+               "contextStart": -(filter_size // 2)})
+    out = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(out)
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """Sinusoidal position mix-in (reference: layers/nn.py
+    add_position_encoding)."""
+    return _simple_op_layer(
+        "add_position_encoding", {"X": input},
+        {"alpha": float(alpha), "beta": float(beta)}, name=name)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None):
+    """3-D convolution, NCDHW (reference: layers/nn.py conv3d)."""
+    helper = LayerHelper("conv3d", name=name, bias_attr=bias_attr, act=act)
+    c_in = input.shape[1]
+
+    def triple(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    fs = triple(filter_size)
+    w = helper.create_parameter(
+        ParamAttr._to_attr(param_attr),
+        shape=[num_filters, c_in // groups] + fs, dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        "conv3d", inputs={"Input": input, "Filter": w},
+        outputs={"Output": out},
+        attrs={"strides": triple(stride), "paddings": triple(padding),
+               "dilations": triple(dilation), "groups": groups})
+    out = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(out)

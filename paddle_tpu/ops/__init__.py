@@ -17,8 +17,10 @@ from paddle_tpu.ops import (  # noqa: F401
     moe_ops,
     nn_ops,
     optimizer_ops,
+    quant_ops,
     rnn_ops,
     sequence_ops,
     sparse_ops,
     tensor_ops,
+    vision_ops,
 )

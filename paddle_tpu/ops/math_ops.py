@@ -313,3 +313,174 @@ def _fake_quantize_dequantize(ins, attrs):
     scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
     q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax) * scale / qmax
     return {"Out": [x + jax.lax.stop_gradient(q - x)]}
+
+
+@register_op("sign", no_grad=True)
+def _sign(ins, attrs):
+    return {"Out": [jnp.sign(ins["X"][0])]}
+
+
+@register_op("minus", diff_inputs=("X", "Y"))
+def _minus(ins, attrs):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register_op("l1_norm", diff_inputs=("X",))
+def _l1_norm(ins, attrs):
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0]))]}
+
+
+@register_op("squared_l2_distance", diff_inputs=("X", "Y"))
+def _squared_l2_distance(ins, attrs):
+    """Row-wise ||x - y||^2 (reference: squared_l2_distance_op.cc)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    sub = x - y
+    out = jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim)),
+                  keepdims=False)[:, None]
+    return {"Out": [out], "sub_result": [sub]}
+
+
+@register_op("modified_huber_loss", diff_inputs=("X",))
+def _modified_huber_loss(ins, attrs):
+    """y in {0,1} relabeled to {-1,1} (reference:
+    modified_huber_loss_op.cc)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    t = 2.0 * y - 1.0
+    z = x * t
+    loss = jnp.where(
+        z < -1.0, -4.0 * z,
+        jnp.where(z < 1.0, jnp.square(1.0 - z), jnp.zeros_like(z)),
+    )
+    return {"Out": [loss], "IntermediateVal": [z]}
+
+
+@register_op("teacher_student_sigmoid_loss", diff_inputs=("X",))
+def _teacher_student_sigmoid_loss(ins, attrs):
+    """CTR distillation loss (reference:
+    teacher_student_sigmoid_loss_op.cc): label <= 0 -> hard 0/1 part,
+    else teacher-score part."""
+    x, label = ins["X"][0], ins["Label"][0]
+    soft_max_up = float(attrs.get("soft_max_up_bound", 15.0))
+    soft_max_lo = float(attrs.get("soft_max_lower_bound", -15.0))
+    z = jnp.clip(x, soft_max_lo, soft_max_up)
+    # log(1 + exp(z)) - z * indicator(label > 0) + teacher term
+    hard = jnp.log1p(jnp.exp(z)) - jnp.where(label > 0.0, z, 0.0)
+    teacher = jnp.where(
+        label > 0.0,
+        jnp.log1p(jnp.exp(z)) - z * label,
+        jnp.zeros_like(z),
+    )
+    return {"Y": [hard + teacher]}
+
+
+@register_op("cvm", diff_inputs=("X",))
+def _cvm(ins, attrs):
+    """Click-value normalization for CTR features (reference:
+    cvm_op.cc): first two columns are (show, click); use_cvm keeps them
+    log-normalized, else strips them."""
+    x = ins["X"][0]
+    show = jnp.log(x[:, 0:1] + 1.0)
+    click = jnp.log(x[:, 1:2] + 1.0) - show
+    rest = x[:, 2:]
+    if attrs.get("use_cvm", True):
+        return {"Y": [jnp.concatenate([show, click, rest], axis=1)]}
+    return {"Y": [rest]}
+
+
+@register_op("data_norm", diff_inputs=("X",))
+def _data_norm(ins, attrs):
+    """Normalization by accumulated batch statistics (reference:
+    data_norm_op.cc): means = batch_sum/batch_size and
+    scales = sqrt(batch_size/batch_square_sum) — no mean subtraction in
+    the scale, matching the reference exactly."""
+    x = ins["X"][0]
+    bsize = ins["BatchSize"][0]
+    bsum = ins["BatchSum"][0]
+    bsq = ins["BatchSquareSum"][0]
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsq)
+    return {"Y": [(x - means) * scales], "Means": [means],
+            "Scales": [scales]}
+
+
+@register_op("spectral_norm", diff_inputs=("Weight",))
+def _spectral_norm(ins, attrs):
+    """Spectral normalization via stored power-iteration vectors
+    (reference: spectral_norm_op.cc)."""
+    w = ins["Weight"][0]
+    u = ins["U"][0].reshape(-1)
+    v = ins["V"][0].reshape(-1)
+    dim = int(attrs.get("dim", 0))
+    power_iters = int(attrs.get("power_iters", 1))
+    eps = float(attrs.get("eps", 1e-12))
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(power_iters):
+        v = wm.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wm @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ wm @ v
+    return {"Out": [w / sigma]}
+
+
+@register_op("fsp", diff_inputs=("X", "Y"))
+def _fsp(ins, attrs):
+    """Flow-of-solution-procedure matrix for distillation (reference:
+    fsp_op.cc): Gram matrix between two feature maps."""
+    x, y = ins["X"][0], ins["Y"][0]
+    n, cx, h, w = x.shape
+    cy = y.shape[1]
+    xf = x.reshape(n, cx, h * w)
+    yf = y.reshape(n, cy, h * w)
+    out = jnp.einsum("ncl,nkl->nck", xf, yf) / (h * w)
+    return {"Out": [out]}
+
+
+@register_op("is_empty", no_grad=True)
+def _is_empty(ins, attrs):
+    return {"Out": [jnp.asarray(ins["X"][0].size == 0)]}
+
+
+@register_op("fill", no_grad=True)
+def _fill(ins, attrs):
+    import numpy as _np
+
+    data = _np.asarray(attrs["value"], dtype=attrs.get("dtype", "float32"))
+    return {"Out": [jnp.asarray(data.reshape(attrs["shape"]))]}
+
+
+@register_op("fill_constant_batch_size_like", no_grad=True)
+def _fill_constant_batch_size_like(ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    in_dim = int(attrs.get("input_dim_idx", 0))
+    out_dim = int(attrs.get("output_dim_idx", 0))
+    shape[out_dim] = ref.shape[in_dim]
+    return {"Out": [jnp.full(tuple(shape), attrs.get("value", 0.0),
+                             dtype=attrs.get("dtype", "float32"))]}
+
+
+@register_op("uniform_random_batch_size_like", needs_rng=True, no_grad=True)
+def _uniform_random_batch_size_like(ins, attrs, rng=None):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[int(attrs.get("output_dim_idx", 0))] = ref.shape[
+        int(attrs.get("input_dim_idx", 0))]
+    lo, hi = attrs.get("min", -1.0), attrs.get("max", 1.0)
+    out = jax.random.uniform(rng, tuple(shape), minval=lo, maxval=hi,
+                             dtype=attrs.get("dtype", "float32"))
+    return {"Out": [out]}
+
+
+@register_op("gaussian_random_batch_size_like", needs_rng=True, no_grad=True)
+def _gaussian_random_batch_size_like(ins, attrs, rng=None):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[int(attrs.get("output_dim_idx", 0))] = ref.shape[
+        int(attrs.get("input_dim_idx", 0))]
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    out = mean + std * jax.random.normal(
+        rng, tuple(shape), dtype=attrs.get("dtype", "float32"))
+    return {"Out": [out]}

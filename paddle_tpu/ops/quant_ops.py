@@ -1,0 +1,161 @@
+"""Quantization ops: the reference's fake-quant family plus the int8
+convert pipeline.
+
+Reference kernels: paddle/fluid/operators/fake_quantize_op.cc (abs_max,
+channel_wise_abs_max, range_abs_max, moving_average_abs_max variants),
+fake_dequantize_op.cc, and operators/{quantize,dequantize,requantize}_op.cc
+(int8 convert). Training-time fake-quant ops use the straight-through
+estimator baked into the expression (``x + sg(q(x) - x)``) so the auto
+vjp yields identity gradients inside the clip range — the reference's
+grad kernels do the same pass-through.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    v = ins.get(slot)
+    return v[i] if v else None
+
+
+def _qmax(attrs):
+    bits = int(attrs.get("bit_length", attrs.get("bits", 8)))
+    return float(2 ** (bits - 1) - 1)
+
+
+def _ste(x, scale, qmax):
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax) * scale / qmax
+    return x + jax.lax.stop_gradient(q - x)
+
+
+@register_op("fake_quantize_abs_max", diff_inputs=("X",))
+def _fake_quantize_abs_max(ins, attrs):
+    x = _x(ins)
+    qmax = _qmax(attrs)
+    scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    return {"Out": [_ste(x, scale, qmax)], "OutScale": [scale.reshape(1)]}
+
+
+@register_op("fake_channel_wise_quantize_abs_max", diff_inputs=("X",))
+def _fake_channel_wise_quantize_abs_max(ins, attrs):
+    """Per-output-channel scales (dim 0, the conv-filter convention)."""
+    x = _x(ins)
+    qmax = _qmax(attrs)
+    flat = jnp.abs(x).reshape(x.shape[0], -1)
+    scale = jnp.maximum(jnp.max(flat, axis=1), 1e-8)
+    s = scale.reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"Out": [_ste(x, s, qmax)], "OutScale": [scale]}
+
+
+@register_op("fake_quantize_range_abs_max", diff_inputs=("X",),
+             inplace={"OutScales": "InScales"})
+def _fake_quantize_range_abs_max(ins, attrs):
+    """Sliding max over a window of per-step scales (reference:
+    fake_quantize_op.cc range_abs_max): InScales is the rolling history
+    buffer, Iter the step counter."""
+    x = _x(ins)
+    hist = _x(ins, "InScales")
+    it = _x(ins, "Iter")
+    qmax = _qmax(attrs)
+    cur = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    if attrs.get("is_test", False):
+        scale = jnp.maximum(jnp.max(hist), 1e-8)
+        return {"Out": [_ste(x, scale, qmax)],
+                "OutScale": [scale.reshape(1)],
+                "OutScales": [hist], "IterOut": [it]}
+    window = hist.shape[0]
+    pos = (it.reshape(()).astype(jnp.int32)) % window
+    hist = hist.at[pos].set(cur)
+    scale = jnp.maximum(jnp.max(hist), 1e-8)
+    return {"Out": [_ste(x, scale, qmax)], "OutScale": [scale.reshape(1)],
+            "OutScales": [hist], "IterOut": [it + 1]}
+
+
+@register_op("fake_quantize_moving_average_abs_max", diff_inputs=("X",),
+             inplace={"OutState": "InState", "OutAccum": "InAccum"})
+def _fake_quantize_moving_average_abs_max(ins, attrs):
+    """EMA of abs-max (reference: fake_quantize_op.cc moving_average)."""
+    x = _x(ins)
+    state = _x(ins, "InState")
+    accum = _x(ins, "InAccum")
+    rate = float(attrs.get("moving_rate", 0.9))
+    qmax = _qmax(attrs)
+    cur = jnp.max(jnp.abs(x))
+    if attrs.get("is_test", False):
+        scale = jnp.maximum(accum.reshape(()) / state.reshape(()), 1e-8)
+        return {"Out": [_ste(x, scale, qmax)],
+                "OutScale": [scale.reshape(1)],
+                "OutState": [state], "OutAccum": [accum]}
+    state_n = rate * state.reshape(()) + 1.0
+    accum_n = rate * accum.reshape(()) + cur
+    scale = jnp.maximum(accum_n / state_n, 1e-8)
+    return {"Out": [_ste(x, scale, qmax)], "OutScale": [scale.reshape(1)],
+            "OutState": [state_n.reshape(1)], "OutAccum": [accum_n.reshape(1)]}
+
+
+@register_op("moving_average_abs_max_scale", diff_inputs=("X",),
+             inplace={"OutState": "InState", "OutAccum": "InAccum"})
+def _moving_average_abs_max_scale(ins, attrs):
+    """Scale observer only — passes X through untouched (reference:
+    fake_quantize_op.cc MovingAverageAbsMaxScaleOp)."""
+    x = _x(ins)
+    state = _x(ins, "InState")
+    accum = _x(ins, "InAccum")
+    rate = float(attrs.get("moving_rate", 0.9))
+    cur = jnp.max(jnp.abs(x))
+    state_n = rate * state.reshape(()) + 1.0
+    accum_n = rate * accum.reshape(()) + cur
+    scale = jnp.maximum(accum_n / state_n, 1e-8)
+    return {"Out": [x], "OutScale": [scale.reshape(1)],
+            "OutState": [state_n.reshape(1)], "OutAccum": [accum_n.reshape(1)]}
+
+
+@register_op("fake_dequantize_max_abs", diff_inputs=("X",))
+def _fake_dequantize_max_abs(ins, attrs):
+    x, scale = _x(ins), _x(ins, "Scale")
+    qmax = float(attrs.get("max_range", _qmax(attrs)))
+    return {"Out": [x.astype(jnp.float32) * scale.reshape(()) / qmax]}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs", diff_inputs=("X",))
+def _fake_channel_wise_dequantize_max_abs(ins, attrs):
+    x = _x(ins)
+    scales = ins.get("Scales", [])
+    qmax = _qmax(attrs)
+    out = x.astype(jnp.float32)
+    s0 = scales[0]
+    out = out * s0.reshape((-1,) + (1,) * (x.ndim - 1)) / qmax
+    if len(scales) > 1 and scales[1] is not None:
+        out = out * scales[1].reshape(()) / qmax
+    return {"Out": [out]}
+
+
+@register_op("quantize", no_grad=True)
+def _quantize(ins, attrs):
+    """f32 -> int8 with a given scale (reference: quantize_op.cc)."""
+    x = _x(ins, "Input")
+    scale = float(attrs.get("Scale", 1.0))
+    q = jnp.clip(jnp.round(x * scale), -128, 127).astype(jnp.int8)
+    return {"Output": [q]}
+
+
+@register_op("dequantize", no_grad=True)
+def _dequantize(ins, attrs):
+    x = _x(ins, "Input")
+    scale = float(attrs.get("Scale", 1.0))
+    return {"Output": [x.astype(jnp.float32) / scale]}
+
+
+@register_op("requantize", no_grad=True)
+def _requantize(ins, attrs):
+    x = _x(ins, "Input")
+    scale_in = float(attrs.get("Scale_in", 1.0))
+    scale_out = float(attrs.get("Scale_out", 1.0))
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) * scale_out / scale_in),
+                 -128, 127).astype(jnp.int8)
+    return {"Output": [q]}

@@ -200,3 +200,51 @@ def _gru_unit(ins, attrs):
     h = u * h_prev + (1.0 - u) * c
     gate = jnp.concatenate([u, r, c], axis=-1)
     return {"Hidden": [h], "Gate": [gate], "ResetHiddenPrev": [rh]}
+
+
+@register_op("lstm_unit", diff_inputs=("X", "C_prev"))
+def _lstm_unit(ins, attrs):
+    """Single fused LSTM cell step on pre-projected gates (reference:
+    lstm_unit_op.cc). X [b, 4d] (i, f, c, o gate order), C_prev [b, d]."""
+    x, c_prev = ins["X"][0], ins["C_prev"][0]
+    forget_bias = float(attrs.get("forget_bias", 0.0))
+    d = c_prev.shape[-1]
+    i, f, c, o = (x[:, :d], x[:, d:2 * d], x[:, 2 * d:3 * d], x[:, 3 * d:])
+    c_new = (jax.nn.sigmoid(f + forget_bias) * c_prev
+             + jax.nn.sigmoid(i) * jnp.tanh(c))
+    h = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return {"C": [c_new], "H": [h]}
+
+
+@register_op("lstmp", diff_inputs=("Input", "Weight", "ProjWeight", "Bias"))
+def _lstmp(ins, attrs):
+    """LSTM with a recurrent projection layer (reference: lstmp_op.cc).
+    Input [b, t, 4d] pre-projected gate activations; Weight [p, 4d]
+    recurrent weights over the projected state; ProjWeight [d, p]."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]
+    w_proj = ins["ProjWeight"][0]
+    b_in = ins.get("Bias")
+    bias = b_in[0] if b_in else None
+    b, t, d4 = x.shape
+    d = d4 // 4
+    p = w_proj.shape[1]
+
+    def step(carry, xt):
+        h_p, c = carry
+        gates = xt + h_p @ w
+        if bias is not None:
+            gates = gates + bias.reshape(-1)[:d4]
+        i = jax.nn.sigmoid(gates[:, :d])
+        f = jax.nn.sigmoid(gates[:, d:2 * d])
+        g = jnp.tanh(gates[:, 2 * d:3 * d])
+        o = jax.nn.sigmoid(gates[:, 3 * d:])
+        c_new = f * c + i * g
+        h = o * jnp.tanh(c_new)
+        h_proj = h @ w_proj
+        return (h_proj, c_new), (h_proj, h)
+
+    h0 = jnp.zeros((b, p), x.dtype)
+    c0 = jnp.zeros((b, d), x.dtype)
+    (_, _), (hs, _) = jax.lax.scan(step, (h0, c0), x.transpose(1, 0, 2))
+    return {"Projection": [hs.transpose(1, 0, 2)]}

@@ -268,3 +268,82 @@ def _sequence_expand_as(ins, attrs):
         x[:, None, :], (jnp.shape(x)[0], jnp.shape(y)[1], jnp.shape(x)[-1])
     )
     return {"Out": [out * mask[:, :, None].astype(out.dtype)]}
+
+
+@register_op("sequence_conv", diff_inputs=("X", "Filter"))
+def _sequence_conv(ins, attrs):
+    """1-D context-window convolution over padded [b, t, d] sequences
+    (reference: sequence_conv_op.cc; LoD rows become masked rows here).
+    Filter [ctx_len * d, m]."""
+    x, w = _x(ins), _x(ins, "Filter")
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -(ctx_len // 2)))
+    b, t, d = x.shape
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        shifted = jnp.roll(x, -off, axis=1)
+        if off < 0:
+            mask = (jnp.arange(t) >= -off)[None, :, None]
+        elif off > 0:
+            mask = (jnp.arange(t) < t - off)[None, :, None]
+        else:
+            mask = jnp.ones((1, t, 1), bool)
+        cols.append(jnp.where(mask, shifted, 0.0))
+    im = jnp.concatenate(cols, axis=-1)          # [b, t, ctx_len*d]
+    return {"Out": [im @ w]}
+
+
+@register_op("sequence_reshape", diff_inputs=("X",))
+def _sequence_reshape(ins, attrs):
+    """Redistribute timesteps so the feature dim becomes new_dim
+    (reference: sequence_reshape_op.cc)."""
+    x = _x(ins)
+    new_dim = int(attrs["new_dim"])
+    b, t, d = x.shape
+    return {"Out": [x.reshape(b, t * d // new_dim, new_dim)]}
+
+
+@register_op("sequence_scatter", diff_inputs=("X", "Updates"))
+def _sequence_scatter(ins, attrs):
+    """Scatter per-sequence updates into X by in-row ids (reference:
+    sequence_scatter_op.cc). X [b, d]; Ids [b, k]; Updates [b, k]."""
+    x, ids, upd = _x(ins), _x(ins, "Ids"), _x(ins, "Updates")
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+
+    def one(row, ii, uu):
+        return row.at[ii].add(uu)
+
+    return {"Out": [jax.vmap(one)(x, ids.astype(jnp.int32), upd)]}
+
+
+@register_op("add_position_encoding", diff_inputs=("X",))
+def _add_position_encoding(ins, attrs):
+    """alpha * x + beta * sinusoid(pos) (reference:
+    add_position_encoding_op.cc)."""
+    x = _x(ins)
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2.0 * i / d)
+    enc = jnp.zeros((t, d), jnp.float32)
+    enc = enc.at[:, 0::2].set(jnp.sin(angle))
+    enc = enc.at[:, 1::2].set(jnp.cos(angle))
+    return {"Out": [alpha * x + beta * enc[None].astype(x.dtype)]}
+
+
+@register_op("conv_shift", diff_inputs=("X", "Y"))
+def _conv_shift(ins, attrs):
+    """Circular convolution (reference: conv_shift_op.cc). X [b, n];
+    Y [b, m] with m odd, m <= n."""
+    x, y = _x(ins), _x(ins, "Y")
+    b, n = x.shape
+    m = y.shape[1]
+    half = m // 2
+    outs = []
+    for j in range(m):
+        outs.append(jnp.roll(x, half - j, axis=1) * y[:, j:j + 1])
+    return {"Out": [sum(outs)]}

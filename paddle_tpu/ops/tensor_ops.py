@@ -216,13 +216,15 @@ def _one_hot(ins, attrs):
 def _lookup_table_grad_maker(op, block, out_grads, provide, should_skip):
     """Emit the row-sparse grad pair when the layer asked for
     ``is_sparse=True`` (the SelectedRows capability, reference:
-    lookup_table_op.cc grad -> SelectedRows); otherwise the standard dense
-    auto-vjp grad desc. The sparse pair is two IR vars named
-    ``{W}@GRAD@ROWS`` / ``{W}@GRAD@VALUES``; the ``{W}@GRAD`` variable
-    itself becomes a never-materialized marker carrying
+    lookup_table_op.cc grad -> SelectedRows); dense lookups defer to the
+    generic auto-vjp grad emitter (return None). The sparse pair is two IR
+    vars named ``{W}@GRAD@ROWS`` / ``{W}@GRAD@VALUES``; the ``{W}@GRAD``
+    variable itself becomes a never-materialized marker carrying
     ``is_selected_rows`` so the optimizer dispatches to its sparse op."""
     from paddle_tpu.core.registry import get_op_def
 
+    if not op.attrs.get("is_sparse", False):
+        return None  # generic dense path
     w = op.inputs["W"][0]
     g_out = (out_grads.get("Out") or [""])[0]
     if not g_out:
@@ -232,22 +234,6 @@ def _lookup_table_grad_maker(op, block, out_grads, provide, should_skip):
         return []
     src = block._find_var_recursive(w)
     gname = provide(w)
-    if not op.attrs.get("is_sparse", False):
-        block.create_var(name=gname, shape=src.shape if src else None,
-                         dtype=src.dtype if src else "float32")
-        g_inputs = dict(op.inputs)
-        for slot, names in op.outputs.items():
-            g_inputs.setdefault(slot, names)
-        g_inputs["GRAD::Out"] = [g_out]
-        attrs = dict(op.attrs)
-        attrs["fwd_input_slots"] = list(op.inputs.keys())
-        attrs["fwd_output_slots"] = list(op.outputs.keys())
-        return [dict(
-            type="lookup_table_grad",
-            inputs=g_inputs,
-            outputs={"GRAD::W": [gname], "GRAD::Ids": [""]},
-            attrs=attrs,
-        )]
     if "@RENAME@" in gname:
         raise ValueError(
             f"lookup_table(is_sparse=True): table '{w}' is consumed by "
@@ -717,3 +703,42 @@ def _anchor_generator(ins, attrs):
     ], axis=-1)
     var = jnp.broadcast_to(jnp.asarray(variances), (h, w, a, 4))
     return {"Anchors": [anchors], "Variances": [var]}
+
+
+# --- v1-named aliases of the *2 ops (reference registers both; the v1
+# forms lack the XShape side output) ---
+
+
+@register_op("reshape", diff_inputs=("X",))
+def _reshape_v1(ins, attrs):
+    x = _x(ins)
+    shape = [int(s) for s in attrs["shape"]]
+    out_shape = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out_shape.append(x.shape[i])
+        else:
+            out_shape.append(s)
+    return {"Out": [jnp.reshape(x, out_shape)]}
+
+
+@register_op("transpose", diff_inputs=("X",))
+def _transpose_v1(ins, attrs):
+    return {"Out": [jnp.transpose(_x(ins), attrs["axis"])]}
+
+
+@register_op("squeeze", diff_inputs=("X",))
+def _squeeze_v1(ins, attrs):
+    x = _x(ins)
+    axes = attrs.get("axes", [])
+    if not axes:
+        return {"Out": [jnp.squeeze(x)]}
+    return {"Out": [jnp.squeeze(x, axis=tuple(axes))]}
+
+
+@register_op("unsqueeze", diff_inputs=("X",))
+def _unsqueeze_v1(ins, attrs):
+    x = _x(ins)
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": [x]}

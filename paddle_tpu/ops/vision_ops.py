@@ -1,0 +1,574 @@
+"""Vision ops: RoI extraction, detection decoding/NMS, 3-D conv/pool,
+pooling variants, and spatial transforms.
+
+Reference kernels: paddle/fluid/operators/{roi_pool_op.cc, roi_align_op.cc,
+detection/yolo_box_op.cc, detection/box_clip_op.cc,
+detection/multiclass_nms_op.cc, detection/density_prior_box_op.cc,
+detection/bipartite_match_op.cc, conv_op.cc (3d), pool_op.cc (3d),
+max_pool_with_index_op.cc, unpool_op.cc, spp_op.cc, lrn_op.cc,
+affine_grid_op.cc, random_crop_op.cc}. All static-shape (XLA discipline):
+NMS emits fixed-capacity outputs padded with -1 labels instead of the
+reference's variable-length LoD results.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    v = ins.get(slot)
+    return v[i] if v else None
+
+
+def _pair3(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v, v, v)
+
+
+# --------------------------------------------------------------------------
+# RoI ops
+# --------------------------------------------------------------------------
+
+
+def _roi_bounds(roi, spatial_scale):
+    x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+    return (x1 * spatial_scale, y1 * spatial_scale,
+            x2 * spatial_scale, y2 * spatial_scale)
+
+
+@register_op("roi_align", diff_inputs=("X",))
+def _roi_align(ins, attrs):
+    """Bilinear RoI align (reference: roi_align_op.cc). X [n, c, h, w];
+    ROIs [r, 4] (x1, y1, x2, y2); RoisNum/batch ids via BatchId [r] (all
+    zeros when absent, matching single-image usage)."""
+    x = _x(ins)
+    rois = _x(ins, "ROIs")
+    batch_ids = _x(ins, "BatchId")
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ratio = int(attrs.get("sampling_ratio", -1))
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    if batch_ids is None:
+        batch_ids = jnp.zeros((r,), jnp.int32)
+    sr = ratio if ratio > 0 else 2
+
+    def one_roi(roi, bid):
+        rx1, ry1, rx2, ry2 = _roi_bounds(roi, scale)
+        rw = jnp.maximum(rx2 - rx1, 1.0)
+        rh = jnp.maximum(ry2 - ry1, 1.0)
+        bin_w, bin_h = rw / pw, rh / ph
+        img = x[bid]  # (c, h, w)
+        # sample grid: ph*sr x pw*sr bilinear points
+        ys = ry1 + (jnp.arange(ph * sr) + 0.5) * bin_h / sr
+        xs = rx1 + (jnp.arange(pw * sr) + 0.5) * bin_w / sr
+
+        def bilinear(yy, xx):
+            y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            ly, lx = yy - y0, xx - x0
+            y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+            y1i, x1i = y1_.astype(jnp.int32), x1_.astype(jnp.int32)
+            v = (img[:, y0i, x0i] * (1 - ly) * (1 - lx)
+                 + img[:, y1i, x0i] * ly * (1 - lx)
+                 + img[:, y0i, x1i] * (1 - ly) * lx
+                 + img[:, y1i, x1i] * ly * lx)
+            inside = (yy >= -1) & (yy <= h) & (xx >= -1) & (xx <= w)
+            return jnp.where(inside, v, 0.0)
+
+        yy = jnp.repeat(ys, pw * sr).reshape(ph * sr, pw * sr)
+        xx = jnp.tile(xs, (ph * sr, 1))
+        samples = jax.vmap(
+            jax.vmap(bilinear, in_axes=(0, 0)), in_axes=(0, 0)
+        )(yy, xx)                                    # (ph*sr, pw*sr, c)
+        samples = samples.reshape(ph, sr, pw, sr, c)
+        return jnp.mean(samples, axis=(1, 3)).transpose(2, 0, 1)
+
+    out = jax.vmap(one_roi)(rois.astype(jnp.float32), batch_ids)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("roi_pool", diff_inputs=("X",))
+def _roi_pool(ins, attrs):
+    """Quantized max RoI pooling (reference: roi_pool_op.cc)."""
+    x = _x(ins)
+    rois = _x(ins, "ROIs")
+    batch_ids = _x(ins, "BatchId")
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    if batch_ids is None:
+        batch_ids = jnp.zeros((r,), jnp.int32)
+
+    hh = jnp.arange(h)
+    ww = jnp.arange(w)
+
+    def one_roi(roi, bid):
+        rx1 = jnp.round(roi[0] * scale)
+        ry1 = jnp.round(roi[1] * scale)
+        rx2 = jnp.round(roi[2] * scale)
+        ry2 = jnp.round(roi[3] * scale)
+        rw = jnp.maximum(rx2 - rx1 + 1, 1.0)
+        rh = jnp.maximum(ry2 - ry1 + 1, 1.0)
+        img = x[bid]
+
+        def one_bin(iy, ix):
+            y_lo = jnp.floor(ry1 + iy * rh / ph)
+            y_hi = jnp.ceil(ry1 + (iy + 1) * rh / ph)
+            x_lo = jnp.floor(rx1 + ix * rw / pw)
+            x_hi = jnp.ceil(rx1 + (ix + 1) * rw / pw)
+            my = (hh >= y_lo) & (hh < jnp.maximum(y_hi, y_lo + 1))
+            mx = (ww >= x_lo) & (ww < jnp.maximum(x_hi, x_lo + 1))
+            mask = my[:, None] & mx[None, :]
+            neg = jnp.finfo(x.dtype).min
+            return jnp.max(jnp.where(mask[None], img, neg), axis=(1, 2))
+
+        iy = jnp.repeat(jnp.arange(ph), pw)
+        ix = jnp.tile(jnp.arange(pw), ph)
+        bins = jax.vmap(one_bin)(iy, ix)             # (ph*pw, c)
+        return bins.T.reshape(c, ph, pw)
+
+    out = jax.vmap(one_roi)(rois.astype(jnp.float32), batch_ids)
+    return {"Out": [out]}
+
+
+# --------------------------------------------------------------------------
+# detection decode / NMS
+# --------------------------------------------------------------------------
+
+
+@register_op("yolo_box", no_grad=True)
+def _yolo_box(ins, attrs):
+    """Decode YOLOv3 head output to boxes+scores (reference:
+    detection/yolo_box_op.cc). X [n, an*(5+cls), h, w]; ImgSize [n, 2]."""
+    x = _x(ins)
+    img_size = _x(ins, "ImgSize")
+    anchors = attrs["anchors"]                       # flat [ax, ay, ...]
+    class_num = int(attrs["class_num"])
+    conf_thresh = float(attrs.get("conf_thresh", 0.01))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    n, _, h, w = x.shape
+    an = len(anchors) // 2
+    x = x.reshape(n, an, 5 + class_num, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)
+    grid_y = jnp.arange(h, dtype=jnp.float32)
+    input_h = downsample * h
+    input_w = downsample * w
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + grid_x[None, None, None, :]) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + grid_y[None, None, :, None]) / h
+    bw = jnp.exp(x[:, :, 2]) * aw / input_w
+    bh = jnp.exp(x[:, :, 3]) * ah / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    keep = conf > conf_thresh
+    probs = jnp.where(keep[:, :, None], probs, 0.0)
+
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    return {"Boxes": [boxes], "Scores": [scores]}
+
+
+@register_op("box_clip", no_grad=True)
+def _box_clip(ins, attrs):
+    """Clip boxes to image bounds (reference: detection/box_clip_op.cc).
+    Input [.., 4], ImInfo [n, 3] (h, w, scale)."""
+    boxes = _x(ins, "Input")
+    im_info = _x(ins, "ImInfo")
+    h = im_info[0, 0] / im_info[0, 2] - 1.0
+    w = im_info[0, 1] / im_info[0, 2] - 1.0
+    x1 = jnp.clip(boxes[..., 0], 0, w)
+    y1 = jnp.clip(boxes[..., 1], 0, h)
+    x2 = jnp.clip(boxes[..., 2], 0, w)
+    y2 = jnp.clip(boxes[..., 3], 0, h)
+    return {"Output": [jnp.stack([x1, y1, x2, y2], axis=-1)]}
+
+
+def _iou_matrix(boxes):
+    """[m, 4] -> [m, m] pairwise IoU."""
+    x1, y1, x2, y2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def _nms_keep(boxes, scores, iou_threshold, top_k):
+    """Greedy NMS with static shapes: returns a keep mask over the top_k
+    score-sorted candidates."""
+    order = jnp.argsort(-scores)[:top_k]
+    b = boxes[order]
+    s = scores[order]
+    iou = _iou_matrix(b)
+    m = s.shape[0]
+
+    def body(i, keep):
+        # suppress i if it overlaps an earlier KEPT box
+        over = (iou[i] > iou_threshold) & (jnp.arange(m) < i) & keep
+        return keep.at[i].set(~jnp.any(over) & keep[i])
+
+    keep = jax.lax.fori_loop(0, m, body, s > 0)
+    return order, keep
+
+
+@register_op("multiclass_nms", no_grad=True)
+def _multiclass_nms(ins, attrs):
+    """Static-shape multiclass NMS (reference:
+    detection/multiclass_nms_op.cc). BBoxes [n, m, 4]; Scores [n, cls, m].
+    Out [n, keep_top_k, 6] rows (label, score, x1, y1, x2, y2), label -1
+    padding — fixed capacity instead of the reference's LoD output."""
+    bboxes = _x(ins, "BBoxes")
+    scores = _x(ins, "Scores")
+    score_thresh = float(attrs.get("score_threshold", 0.0))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", 64))
+    keep_top_k = int(attrs.get("keep_top_k", 16))
+    n, m, _ = bboxes.shape
+    ncls = scores.shape[1]
+    nms_top_k = min(nms_top_k, m)
+
+    def one_image(boxes, sc):
+        all_scores, all_labels, all_boxes = [], [], []
+        for c in range(ncls):
+            s = jnp.where(sc[c] > score_thresh, sc[c], 0.0)
+            order, keep = _nms_keep(boxes, s, nms_thresh, nms_top_k)
+            kept_s = jnp.where(keep, s[order], 0.0)
+            all_scores.append(kept_s)
+            all_labels.append(jnp.full((nms_top_k,), c, jnp.float32))
+            all_boxes.append(boxes[order])
+        cs = jnp.concatenate(all_scores)
+        cl = jnp.concatenate(all_labels)
+        cb = jnp.concatenate(all_boxes, axis=0)
+        top = jnp.argsort(-cs)[:keep_top_k]
+        sel_s = cs[top]
+        valid = sel_s > 0
+        row = jnp.concatenate(
+            [jnp.where(valid, cl[top], -1.0)[:, None], sel_s[:, None],
+             cb[top]], axis=1)
+        return row
+
+    out = jax.vmap(one_image)(bboxes.astype(jnp.float32),
+                              scores.astype(jnp.float32))
+    return {"Out": [out]}
+
+
+@register_op("density_prior_box", no_grad=True)
+def _density_prior_box(ins, attrs):
+    """Density prior boxes (reference: detection/density_prior_box_op.cc).
+    Input [n, c, h, w] feature map, Image [n, c, ih, iw]."""
+    feat = _x(ins, "Input")
+    img = _x(ins, "Image")
+    fixed_sizes = attrs.get("fixed_sizes", [])
+    fixed_ratios = attrs.get("fixed_ratios", [1.0])
+    densities = attrs.get("densities", [1])
+    step_w = float(attrs.get("step_w", 0.0))
+    step_h = float(attrs.get("step_h", 0.0))
+    offset = float(attrs.get("offset", 0.5))
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    sw = step_w or iw / w
+    sh = step_h or ih / h
+    boxes = []
+    for size, density in zip(fixed_sizes, densities):
+        for ratio in fixed_ratios:
+            bw = size * (ratio ** 0.5)
+            bh = size / (ratio ** 0.5)
+            shift = size / density
+            for dy in range(density):
+                for dx in range(density):
+                    cx_off = (offset - 0.5 + (dx + 0.5) * shift / sw
+                              if density > 1 else offset)
+                    cy_off = (offset - 0.5 + (dy + 0.5) * shift / sh
+                              if density > 1 else offset)
+                    cx = (jnp.arange(w) + cx_off) * sw
+                    cy = (jnp.arange(h) + cy_off) * sh
+                    cxg = jnp.tile(cx, (h, 1))
+                    cyg = jnp.repeat(cy, w).reshape(h, w)
+                    boxes.append(jnp.stack([
+                        (cxg - bw / 2) / iw, (cyg - bh / 2) / ih,
+                        (cxg + bw / 2) / iw, (cyg + bh / 2) / ih,
+                    ], axis=-1))
+    num = len(boxes)
+    out = jnp.clip(jnp.stack(boxes, axis=2), 0.0, 1.0)   # (h, w, num, 4)
+    var = attrs.get("variances", [0.1, 0.1, 0.2, 0.2])
+    variances = jnp.broadcast_to(jnp.asarray(var, jnp.float32),
+                                 (h, w, num, 4))
+    return {"Boxes": [out], "Variances": [variances]}
+
+
+@register_op("bipartite_match", no_grad=True)
+def _bipartite_match(ins, attrs):
+    """Greedy bipartite matching (reference:
+    detection/bipartite_match_op.cc). DistMat [m, n] (rows: priors,
+    cols: ground truth)."""
+    dist = _x(ins, "DistMat")
+    m, n = dist.shape
+
+    def body(_, state):
+        match, matched_r, matched_c, d = state
+        idx = jnp.argmax(d)
+        r, c = idx // n, idx % n
+        ok = d[r, c] > 0
+        match = jnp.where(ok, match.at[r].set(c), match)
+        matched_r = jnp.where(ok, matched_r.at[r].set(True), matched_r)
+        matched_c = jnp.where(ok, matched_c.at[c].set(True), matched_c)
+        d = jnp.where(ok, d.at[r, :].set(-1.0).at[:, c].set(-1.0), d)
+        return match, matched_r, matched_c, d
+
+    match0 = jnp.full((m,), -1, jnp.int32)
+    state = (match0, jnp.zeros((m,), bool), jnp.zeros((n,), bool),
+             dist.astype(jnp.float32))
+    match, _, _, _ = jax.lax.fori_loop(0, min(m, n), body, state)
+    matched_dist = jnp.where(
+        match >= 0,
+        jnp.take_along_axis(dist, jnp.maximum(match, 0)[:, None],
+                            axis=1)[:, 0],
+        0.0,
+    )
+    return {"ColToRowMatchIndices": [match[None]],
+            "ColToRowMatchDist": [matched_dist[None]]}
+
+
+# --------------------------------------------------------------------------
+# 3-D conv / pool, pooling variants
+# --------------------------------------------------------------------------
+
+
+@register_op("conv3d", diff_inputs=("Input", "Filter"))
+def _conv3d(ins, attrs):
+    x, w = _x(ins, "Input"), _x(ins, "Filter")
+    strides = _pair3(attrs.get("strides", [1, 1, 1]))
+    pads = _pair3(attrs.get("paddings", [0, 0, 0]))
+    dilations = _pair3(attrs.get("dilations", [1, 1, 1]))
+    groups = int(attrs.get("groups", 1))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": [out]}
+
+
+@register_op("conv3d_transpose", diff_inputs=("Input", "Filter"))
+def _conv3d_transpose(ins, attrs):
+    x, w = _x(ins, "Input"), _x(ins, "Filter")
+    strides = _pair3(attrs.get("strides", [1, 1, 1]))
+    pads = _pair3(attrs.get("paddings", [0, 0, 0]))
+    out = jax.lax.conv_transpose(
+        x, w.transpose(1, 0, 2, 3, 4),
+        strides=strides,
+        padding=[(p, p) for p in pads],
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        transpose_kernel=True,
+    )
+    return {"Output": [out]}
+
+
+def _pool_nd(x, attrs, spatial):
+    ksize = attrs.get("ksize", [2] * spatial)
+    strides = attrs.get("strides", ksize)
+    pads = attrs.get("paddings", [0] * spatial)
+    ptype = attrs.get("pooling_type", "max")
+    if isinstance(ksize, int):
+        ksize = [ksize] * spatial
+    if isinstance(strides, int):
+        strides = [strides] * spatial
+    if isinstance(pads, int):
+        pads = [pads] * spatial
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if attrs.get("global_pooling", False):
+        window = (1, 1) + x.shape[2:]
+        stride = window
+        padding = ((0, 0),) * x.ndim
+    if ptype == "max":
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, window, stride, padding)
+    s = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, window, stride, padding)
+    ones = jnp.ones_like(x)
+    cnt = jax.lax.reduce_window(
+        ones, 0.0, jax.lax.add, window, stride, padding)
+    if attrs.get("exclusive", True):
+        return s / cnt
+    import math as _math
+
+    return s / float(_math.prod(window))
+
+
+@register_op("pool3d", diff_inputs=("X",))
+def _pool3d(ins, attrs):
+    return {"Out": [_pool_nd(_x(ins), attrs, 3)]}
+
+
+@register_op("max_pool2d_with_index", diff_inputs=("X",))
+def _max_pool2d_with_index(ins, attrs):
+    """Max pool emitting flat argmax indices (reference:
+    max_pool_with_index_op.cc), consumed by unpool."""
+    x = _x(ins)
+    out = _pool_nd(x, attrs, 2)
+    n, c, oh, ow = out.shape
+    h, w = x.shape[2], x.shape[3]
+    ksize = attrs.get("ksize", [2, 2])
+    if isinstance(ksize, int):
+        ksize = [ksize, ksize]
+    strides = attrs.get("strides", ksize)
+    if isinstance(strides, int):
+        strides = [strides, strides]
+    pads = attrs.get("paddings", [0, 0])
+    if isinstance(pads, int):
+        pads = [pads, pads]
+    # recover indices: for each output cell, find the argmax position
+    ys = jnp.arange(oh) * strides[0] - pads[0]
+    xs = jnp.arange(ow) * strides[1] - pads[1]
+
+    def cell(img, oy, ox):
+        y0, x0 = ys[oy], xs[ox]
+        wy = jnp.clip(y0 + jnp.arange(ksize[0]), 0, h - 1)
+        wx = jnp.clip(x0 + jnp.arange(ksize[1]), 0, w - 1)
+        patch = img[wy][:, wx]
+        flat = jnp.argmax(patch)
+        iy, ix = flat // ksize[1], flat % ksize[1]
+        return (wy[iy] * w + wx[ix]).astype(jnp.int32)
+
+    oy = jnp.repeat(jnp.arange(oh), ow)
+    ox = jnp.tile(jnp.arange(ow), oh)
+    idx = jax.vmap(
+        jax.vmap(lambda img: jax.vmap(lambda a, b: cell(img, a, b))(oy, ox))
+    )(x).reshape(n, c, oh, ow)
+    return {"Out": [out], "Mask": [idx]}
+
+
+@register_op("unpool", diff_inputs=("X",))
+def _unpool(ins, attrs):
+    """Max unpooling via saved indices (reference: unpool_op.cc)."""
+    x, idx = _x(ins), _x(ins, "Indices")
+    out_h, out_w = attrs["unpooled_height"], attrs["unpooled_width"]
+    n, c, h, w = x.shape
+
+    def one(xi, ii):
+        flat = jnp.zeros((out_h * out_w,), x.dtype)
+        return flat.at[ii.reshape(-1)].add(xi.reshape(-1)).reshape(
+            out_h, out_w)
+
+    out = jax.vmap(jax.vmap(one))(x, idx)
+    return {"Out": [out]}
+
+
+@register_op("spp", diff_inputs=("X",))
+def _spp(ins, attrs):
+    """Spatial pyramid pooling (reference: spp_op.cc): pyramid_height
+    levels of global-to-fine pooling, concatenated flat."""
+    x = _x(ins)
+    levels = int(attrs.get("pyramid_height", 3))
+    ptype = attrs.get("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lvl in range(levels):
+        bins = 2 ** lvl
+        kh, kw = -(-h // bins), -(-w // bins)  # ceil
+        ph, pw = kh * bins - h, kw * bins - w
+        lvl_attrs = {"ksize": [kh, kw], "strides": [kh, kw],
+                     "paddings": [(ph + 1) // 2, (pw + 1) // 2],
+                     "pooling_type": ptype, "exclusive": False}
+        o = _pool_nd(x, lvl_attrs, 2)
+        outs.append(o.reshape(n, -1))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("lrn", diff_inputs=("X",))
+def _lrn(ins, attrs):
+    """Local response normalization across channels (reference:
+    lrn_op.cc)."""
+    x = _x(ins)
+    nsize = int(attrs.get("n", 5))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    k = float(attrs.get("k", 1.0))
+    sq = jnp.square(x)
+    half = nsize // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    c = x.shape[1]
+    acc = sum(pad[:, i:i + c] for i in range(nsize))
+    mid = k + alpha * acc
+    return {"Out": [x / jnp.power(mid, beta)], "MidOut": [mid]}
+
+
+# --------------------------------------------------------------------------
+# spatial transforms
+# --------------------------------------------------------------------------
+
+
+@register_op("affine_grid", diff_inputs=("Theta",))
+def _affine_grid(ins, attrs):
+    """2-D affine sampling grid (reference: affine_grid_op.cc). Theta
+    [n, 2, 3] -> Output [n, h, w, 2] normalized coords."""
+    theta = _x(ins, "Theta")
+    shape = attrs.get("output_shape")
+    if shape:
+        h, w = int(shape[2]), int(shape[3])
+    else:
+        out_shape = _x(ins, "OutputShape")
+        try:
+            h, w = int(out_shape[2]), int(out_shape[3])
+        except (jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError) as e:
+            raise ValueError(
+                "affine_grid: a tensor OutputShape is data-dependent and "
+                "cannot set a static XLA shape; pass output_shape as a "
+                "Python list instead"
+            ) from e
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    grid = jnp.stack([
+        jnp.tile(xs, (h, 1)),
+        jnp.repeat(ys, w).reshape(h, w),
+        jnp.ones((h, w)),
+    ], axis=-1)                                      # (h, w, 3)
+    out = jnp.einsum("hwk,njk->nhwj", grid, theta)
+    return {"Output": [out]}
+
+
+@register_op("random_crop", needs_rng=True, no_grad=True)
+def _random_crop(ins, attrs, rng=None):
+    """Random fixed-size crop (reference: random_crop_op.cc). Crops the
+    trailing dims to attrs['shape']."""
+    x = _x(ins)
+    shape = attrs["shape"]
+    nd = len(shape)
+    lead = x.ndim - nd
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - s
+        key = jax.random.fold_in(rng, i)
+        starts.append(
+            jax.random.randint(key, (), 0, max(limit, 0) + 1))
+    starts_full = [jnp.int32(0)] * lead + starts
+    sizes = list(x.shape[:lead]) + list(shape)
+    out = jax.lax.dynamic_slice(x, starts_full, sizes)
+    return {"Out": [out]}

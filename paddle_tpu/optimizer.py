@@ -121,7 +121,7 @@ class Optimizer:
                     f"regularization on row-sparse parameter '{p.name}' is "
                     f"not supported; use is_sparse=False for this embedding"
                 )
-            if clip_mod.has_clip_attr():
+            if clip_mod.clip_applies_to(p.name):
                 raise NotImplementedError(
                     f"gradient clipping with row-sparse parameter "
                     f"'{p.name}' is not supported (a global-norm clip over "

@@ -1,0 +1,370 @@
+"""Op tests for the round-2 breadth push: vision/RoI/detection, 3-D
+conv/pool, quantization, and misc math/sequence/rnn ops — each against a
+numpy reference, differentiable ones through the numeric-grad harness
+(reference test strategy: unittests/op_test.py)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpHarness
+
+
+def _run(h):
+    outs = h.forward()
+    return {slot: [np.asarray(o)] for slot, o in zip(h.out_slots, outs)}
+
+
+def _r(shape, seed=0, scale=1.0):
+    return (np.random.RandomState(seed).randn(*shape) * scale).astype(
+        np.float32)
+
+
+# --- misc math ---
+
+
+def test_sign():
+    x = _r((3, 4), 1)
+    OpHarness("sign", {"X": x}).check_output({"Out": np.sign(x)})
+
+
+def test_minus_and_grad():
+    x, y = _r((3, 4), 1), _r((3, 4), 2)
+    h = OpHarness("minus", {"X": x, "Y": y})
+    h.check_output({"Out": x - y})
+    h.check_grad(["x_0", "y_0"])
+
+
+def test_l1_norm_grad():
+    x = _r((4, 5), 3)
+    h = OpHarness("l1_norm", {"X": x})
+    h.check_output({"Out": np.abs(x).sum()})
+    h.check_grad(["x_0"])
+
+
+def test_squared_l2_distance():
+    x, y = _r((4, 6), 1), _r((4, 6), 2)
+    h = OpHarness("squared_l2_distance", {"X": x, "Y": y},
+                  out_slots=("Out",))
+    h.check_output({"Out": ((x - y) ** 2).sum(axis=1, keepdims=True)})
+    h.check_grad(["x_0", "y_0"])
+
+
+def test_modified_huber_loss():
+    x = _r((8, 1), 4)
+    y = (np.random.RandomState(5).rand(8, 1) > 0.5).astype(np.float32)
+    t = 2 * y - 1
+    z = x * t
+    exp = np.where(z < -1, -4 * z, np.where(z < 1, (1 - z) ** 2, 0.0))
+    OpHarness("modified_huber_loss", {"X": x, "Y": y},
+              out_slots=("Out",)).check_output({"Out": exp.astype(np.float32)})
+
+
+def test_cvm():
+    x = np.abs(_r((4, 8), 6)) + 0.1
+    out = OpHarness("cvm", {"X": x}, attrs={"use_cvm": True},
+                    out_slots=("Y",))
+    show = np.log(x[:, :1] + 1)
+    click = np.log(x[:, 1:2] + 1) - show
+    exp = np.concatenate([show, click, x[:, 2:]], axis=1)
+    out.check_output({"Y": exp})
+
+
+def test_fsp_grad():
+    x, y = _r((2, 3, 4, 4), 1), _r((2, 5, 4, 4), 2)
+    h = OpHarness("fsp", {"X": x, "Y": y})
+    exp = np.einsum("ncl,nkl->nck", x.reshape(2, 3, 16),
+                    y.reshape(2, 5, 16)) / 16.0
+    h.check_output({"Out": exp.astype(np.float32)})
+    h.check_grad(["x_0", "y_0"])
+
+
+def test_fill_constant_batch_size_like():
+    ref = _r((5, 3), 1)
+    h = OpHarness("fill_constant_batch_size_like", {"Input": ref},
+                  attrs={"shape": [2, 7], "value": 3.5})
+    h.check_output({"Out": np.full((5, 7), 3.5, np.float32)})
+
+
+def test_spectral_norm_normalizes():
+    w = _r((6, 4), 7)
+    u = _r((6,), 8)
+    v = _r((4,), 9)
+    h = OpHarness("spectral_norm", {"Weight": w, "U": u, "V": v},
+                  attrs={"power_iters": 20})
+    out = _run(h)["Out"][0]
+    s = np.linalg.svd(np.asarray(out), compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+# --- v1 aliases ---
+
+
+def test_v1_shape_aliases():
+    x = _r((2, 3, 4), 1)
+    OpHarness("reshape", {"X": x}, attrs={"shape": [2, 12]}).check_output(
+        {"Out": x.reshape(2, 12)})
+    OpHarness("transpose", {"X": x}, attrs={"axis": [1, 0, 2]}).check_output(
+        {"Out": x.transpose(1, 0, 2)})
+    OpHarness("unsqueeze", {"X": x}, attrs={"axes": [0]}).check_output(
+        {"Out": x[None]})
+    OpHarness("squeeze", {"X": x[None]}, attrs={"axes": [0]}).check_output(
+        {"Out": x})
+
+
+# --- pooling / conv variants ---
+
+
+def test_pool3d_avg():
+    x = _r((1, 2, 4, 4, 4), 1)
+    h = OpHarness("pool3d", {"X": x},
+                  attrs={"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                         "pooling_type": "avg"})
+    exp = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7))
+    h.check_output({"Out": exp.astype(np.float32)})
+    h.check_grad(["x_0"])
+
+
+def test_conv3d_matches_manual():
+    x = _r((1, 1, 3, 3, 3), 2)
+    w = _r((2, 1, 2, 2, 2), 3)
+    h = OpHarness("conv3d", {"Input": x, "Filter": w},
+                  out_slots=("Output",))
+    out = _run(h)["Output"][0]
+    assert out.shape == (1, 2, 2, 2, 2)
+    # corner value check
+    manual = (x[0, 0, :2, :2, :2] * w[0, 0]).sum()
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 0, 0], manual,
+                               rtol=1e-5)
+    h.check_grad(["input_0", "filter_0"], atol=5e-4)
+
+
+def test_max_pool2d_with_index_and_unpool_roundtrip():
+    x = _r((1, 1, 4, 4), 5)
+    h = OpHarness("max_pool2d_with_index", {"X": x},
+                  attrs={"ksize": [2, 2], "strides": [2, 2]},
+                  out_slots=("Out", "Mask"))
+    res = _run(h)
+    out, mask = np.asarray(res["Out"][0]), np.asarray(res["Mask"][0])
+    exp = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(out, exp, rtol=1e-6)
+    h2 = OpHarness("unpool", {"X": out, "Indices": mask},
+                   attrs={"unpooled_height": 4, "unpooled_width": 4})
+    unp = np.asarray(_run(h2)["Out"][0])
+    assert unp.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(unp.sum(), out.sum(), rtol=1e-6)
+
+
+def test_spp_shape():
+    x = _r((2, 3, 8, 8), 6)
+    h = OpHarness("spp", {"X": x}, attrs={"pyramid_height": 2})
+    out = np.asarray(_run(h)["Out"][0])
+    assert out.shape == (2, 3 * (1 + 4))
+
+
+def test_lrn_matches_manual():
+    x = np.abs(_r((1, 5, 2, 2), 7))
+    h = OpHarness("lrn", {"X": x}, attrs={"n": 3, "alpha": 0.1,
+                                          "beta": 0.75, "k": 1.0},
+                  out_slots=("Out",))
+    sq = x ** 2
+    pad = np.pad(sq, ((0, 0), (1, 1), (0, 0), (0, 0)))
+    acc = pad[:, 0:5] + pad[:, 1:6] + pad[:, 2:7]
+    exp = x / (1.0 + 0.1 * acc) ** 0.75
+    h.check_output({"Out": exp.astype(np.float32)}, atol=1e-5)
+    h.check_grad(["x_0"])
+
+
+# --- RoI / detection ---
+
+
+def test_roi_align_uniform_image():
+    """On a constant image every aligned value equals the constant."""
+    x = np.full((1, 2, 8, 8), 3.0, np.float32)
+    rois = np.array([[0.0, 0.0, 7.0, 7.0], [2.0, 2.0, 6.0, 6.0]], np.float32)
+    h = OpHarness("roi_align", {"X": x, "ROIs": rois},
+                  attrs={"pooled_height": 2, "pooled_width": 2,
+                         "spatial_scale": 1.0})
+    out = np.asarray(_run(h)["Out"][0])
+    assert out.shape == (2, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.0, rtol=1e-5)
+    h.check_grad(["x_0"])
+
+
+def test_roi_pool_picks_max():
+    x = np.zeros((1, 1, 6, 6), np.float32)
+    x[0, 0, 1, 1] = 5.0
+    x[0, 0, 4, 4] = 7.0
+    rois = np.array([[0.0, 0.0, 5.0, 5.0]], np.float32)
+    h = OpHarness("roi_pool", {"X": x, "ROIs": rois},
+                  attrs={"pooled_height": 2, "pooled_width": 2,
+                         "spatial_scale": 1.0})
+    out = np.asarray(_run(h)["Out"][0])
+    assert out[0, 0, 0, 0] == 5.0
+    assert out[0, 0, 1, 1] == 7.0
+
+
+def test_box_clip():
+    boxes = np.array([[[-5.0, -5.0, 20.0, 20.0]]], np.float32)
+    im_info = np.array([[10.0, 12.0, 1.0]], np.float32)
+    h = OpHarness("box_clip", {"Input": boxes, "ImInfo": im_info},
+                  out_slots=("Output",))
+    out = np.asarray(_run(h)["Output"][0])
+    np.testing.assert_allclose(out[0, 0], [0.0, 0.0, 11.0, 9.0])
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                     np.float32)
+    scores = np.array([[[0.9, 0.85, 0.6]]], np.float32)  # one class
+    h = OpHarness("multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+                  attrs={"nms_threshold": 0.5, "keep_top_k": 3,
+                         "score_threshold": 0.1})
+    out = np.asarray(_run(h)["Out"][0])
+    labels = out[0, :, 0]
+    kept = labels >= 0
+    assert kept.sum() == 2  # the 0.85 box is suppressed by the 0.9 box
+    np.testing.assert_allclose(sorted(out[0, kept, 1]), [0.6, 0.9])
+
+
+def test_yolo_box_shapes():
+    n, an, cls, hw = 1, 2, 3, 4
+    x = _r((n, an * (5 + cls), hw, hw), 8, 0.1)
+    img = np.array([[128, 128]], np.int32)
+    h = OpHarness("yolo_box", {"X": x, "ImgSize": img},
+                  attrs={"anchors": [10, 13, 16, 30], "class_num": cls,
+                         "downsample_ratio": 32},
+                  out_slots=("Boxes", "Scores"))
+    res = _run(h)
+    assert np.asarray(res["Boxes"][0]).shape == (1, an * hw * hw, 4)
+    assert np.asarray(res["Scores"][0]).shape == (1, an * hw * hw, cls)
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.1], [0.8, 0.7], [0.2, 0.95]], np.float32)
+    h = OpHarness("bipartite_match", {"DistMat": dist},
+                  out_slots=("ColToRowMatchIndices", "ColToRowMatchDist"))
+    res = _run(h)
+    match = np.asarray(res["ColToRowMatchIndices"][0])[0]
+    # greedy: (2,1)=0.95 first, then (0,0)=0.9
+    assert match[2] == 1 and match[0] == 0 and match[1] == -1
+
+
+def test_affine_grid_identity():
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32),
+                    (1, 1, 1))
+    h = OpHarness("affine_grid", {"Theta": theta},
+                  attrs={"output_shape": [1, 1, 3, 3]},
+                  out_slots=("Output",))
+    out = np.asarray(_run(h)["Output"][0])
+    np.testing.assert_allclose(out[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(out[0, 2, 2], [1, 1], atol=1e-6)
+    h.check_grad(["theta_0"])
+
+
+# --- quantization ---
+
+
+def test_fake_quantize_abs_max_and_ste_grad():
+    x = _r((4, 4), 9)
+    h = OpHarness("fake_quantize_abs_max", {"X": x},
+                  attrs={"bit_length": 8}, out_slots=("Out", "OutScale"))
+    res = _run(h)
+    out = np.asarray(res["Out"][0])
+    scale = float(np.asarray(res["OutScale"][0]))
+    np.testing.assert_allclose(scale, np.abs(x).max(), rtol=1e-6)
+    q = np.clip(np.round(x / scale * 127), -127, 127) * scale / 127
+    np.testing.assert_allclose(out, q, rtol=1e-5, atol=1e-6)
+
+
+def test_fake_channel_wise_quantize():
+    x = _r((3, 8), 10)
+    h = OpHarness("fake_channel_wise_quantize_abs_max", {"X": x},
+                  out_slots=("Out", "OutScale"))
+    res = _run(h)
+    scales = np.asarray(res["OutScale"][0])
+    np.testing.assert_allclose(scales, np.abs(x).max(axis=1), rtol=1e-6)
+
+
+def test_quant_dequant_roundtrip():
+    x = _r((4, 4), 11)
+    scale = 127.0 / np.abs(x).max()
+    hq = OpHarness("quantize", {"Input": x}, attrs={"Scale": float(scale)},
+                   out_slots=("Output",))
+    q = _run(hq)["Output"][0]
+    assert q.dtype == np.int8
+    hd = OpHarness("dequantize", {"Input": q}, attrs={"Scale": float(scale)},
+                   out_slots=("Output",))
+    dq = _run(hd)["Output"][0]
+    np.testing.assert_allclose(dq, x, atol=1.0 / scale)
+
+
+# --- sequence / rnn ---
+
+
+def test_sequence_conv_matches_manual():
+    x = _r((2, 5, 3), 12)
+    w = _r((9, 4), 13)  # ctx_len 3 * d 3 -> 4
+    h = OpHarness("sequence_conv", {"X": x, "Filter": w},
+                  attrs={"contextLength": 3, "contextStart": -1})
+    cols = []
+    for off in (-1, 0, 1):
+        sh = np.zeros_like(x)
+        if off < 0:
+            sh[:, -off:] = x[:, :off]
+        elif off > 0:
+            sh[:, :-off] = x[:, off:]
+        else:
+            sh = x
+        cols.append(sh)
+    im = np.concatenate(cols, axis=-1)
+    h.check_output({"Out": (im @ w).astype(np.float32)}, atol=1e-5)
+    h.check_grad(["x_0", "filter_0"])
+
+
+def test_add_position_encoding_grad():
+    x = _r((2, 6, 8), 14)
+    h = OpHarness("add_position_encoding", {"X": x},
+                  attrs={"alpha": 1.0, "beta": 0.5})
+    out = np.asarray(_run(h)["Out"][0])
+    assert out.shape == x.shape
+    h.check_grad(["x_0"])
+
+
+def test_conv_shift_circular():
+    x = _r((2, 8), 15)
+    y = _r((2, 3), 16)
+    h = OpHarness("conv_shift", {"X": x, "Y": y})
+    exp = np.zeros_like(x)
+    for j in range(3):
+        exp += np.roll(x, 1 - j, axis=1) * y[:, j:j + 1]
+    h.check_output({"Out": exp.astype(np.float32)}, atol=1e-5)
+    h.check_grad(["x_0", "y_0"])
+
+
+def test_lstm_unit_step():
+    x = _r((3, 16), 17)
+    c = _r((3, 4), 18)
+    h = OpHarness("lstm_unit", {"X": x, "C_prev": c},
+                  out_slots=("C", "H"))
+    res = _run(h)
+
+    def sig(a):
+        return 1 / (1 + np.exp(-a))
+
+    i, f, g, o = x[:, :4], x[:, 4:8], x[:, 8:12], x[:, 12:]
+    c_new = sig(f) * c + sig(i) * np.tanh(g)
+    np.testing.assert_allclose(np.asarray(res["C"][0]), c_new, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(res["H"][0]),
+                               sig(o) * np.tanh(c_new), atol=1e-5)
+    h.check_grad(["x_0", "c_prev_0"])
+
+
+def test_lstmp_shapes_and_grad():
+    x = _r((2, 4, 16), 19, 0.3)
+    w = _r((3, 16), 20, 0.3)     # p=3
+    wp = _r((4, 3), 21, 0.3)     # d=4 -> p=3
+    h = OpHarness("lstmp", {"Input": x, "Weight": w, "ProjWeight": wp},
+                  out_slots=("Projection",))
+    out = np.asarray(_run(h)["Projection"][0])
+    assert out.shape == (2, 4, 3)
+    h.check_grad(["input_0", "weight_0", "projweight_0"])
