@@ -307,12 +307,13 @@ def _fake_quantize_dequantize(ins, attrs):
     (reference: operators/fake_quantize_op.cc, abs-max variant). The STE
     is baked into the expression — ``x + sg(q(x) - x)`` — so the auto
     vjp gives identity gradients inside the clip range."""
+    from paddle_tpu.ops.quant_ops import _ste
+
     x = ins["X"][0]
     bits = int(attrs.get("bits", 8))
     qmax = float(2 ** (bits - 1) - 1)
     scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
-    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax) * scale / qmax
-    return {"Out": [x + jax.lax.stop_gradient(q - x)]}
+    return {"Out": [_ste(x, scale, qmax)]}
 
 
 @register_op("sign", no_grad=True)
@@ -403,13 +404,18 @@ def _data_norm(ins, attrs):
             "Scales": [scales]}
 
 
-@register_op("spectral_norm", diff_inputs=("Weight",))
+@register_op("spectral_norm", diff_inputs=("Weight",),
+             inplace={"UOut": "U", "VOut": "V"})
 def _spectral_norm(ins, attrs):
-    """Spectral normalization via stored power-iteration vectors
-    (reference: spectral_norm_op.cc)."""
+    """Spectral normalization via stored power-iteration vectors,
+    persisted across steps like the reference's in-place U/V update
+    (reference: spectral_norm_op.cc) — without persistence a
+    power_iters=1 estimate would restart from random init every step."""
     w = ins["Weight"][0]
-    u = ins["U"][0].reshape(-1)
-    v = ins["V"][0].reshape(-1)
+    u0 = ins["U"][0]
+    v0 = ins["V"][0]
+    u = u0.reshape(-1)
+    v = v0.reshape(-1)
     dim = int(attrs.get("dim", 0))
     power_iters = int(attrs.get("power_iters", 1))
     eps = float(attrs.get("eps", 1e-12))
@@ -422,7 +428,8 @@ def _spectral_norm(ins, attrs):
     u = jax.lax.stop_gradient(u)
     v = jax.lax.stop_gradient(v)
     sigma = u @ wm @ v
-    return {"Out": [w / sigma]}
+    return {"Out": [w / sigma], "UOut": [u.reshape(u0.shape)],
+            "VOut": [v.reshape(v0.shape)]}
 
 
 @register_op("fsp", diff_inputs=("X", "Y"))

@@ -205,13 +205,14 @@ def _gru_unit(ins, attrs):
 @register_op("lstm_unit", diff_inputs=("X", "C_prev"))
 def _lstm_unit(ins, attrs):
     """Single fused LSTM cell step on pre-projected gates (reference:
-    lstm_unit_op.cc). X [b, 4d] (i, f, c, o gate order), C_prev [b, d]."""
+    lstm_unit_op.h, caffe2-derived (i, f, o, g) gate order: slot 2 is the
+    OUTPUT gate, slot 3 the tanh candidate). X [b, 4d], C_prev [b, d]."""
     x, c_prev = ins["X"][0], ins["C_prev"][0]
     forget_bias = float(attrs.get("forget_bias", 0.0))
     d = c_prev.shape[-1]
-    i, f, c, o = (x[:, :d], x[:, d:2 * d], x[:, 2 * d:3 * d], x[:, 3 * d:])
+    i, f, o, g = (x[:, :d], x[:, d:2 * d], x[:, 2 * d:3 * d], x[:, 3 * d:])
     c_new = (jax.nn.sigmoid(f + forget_bias) * c_prev
-             + jax.nn.sigmoid(i) * jnp.tanh(c))
+             + jax.nn.sigmoid(i) * jnp.tanh(g))
     h = jax.nn.sigmoid(o) * jnp.tanh(c_new)
     return {"C": [c_new], "H": [h]}
 

@@ -57,7 +57,12 @@ def _roi_align(ins, attrs):
     r = rois.shape[0]
     if batch_ids is None:
         batch_ids = jnp.zeros((r,), jnp.int32)
-    sr = ratio if ratio > 0 else 2
+    # XLA static-shape deviation from the reference: sampling_ratio <= 0
+    # means ADAPTIVE ceil(roi_size/pooled_size) samples per bin in
+    # roi_align_op.cc, which is a data-dependent shape. A fixed 4x4
+    # sample grid per bin is used instead; pass an explicit
+    # sampling_ratio for parity-critical pipelines.
+    sr = ratio if ratio > 0 else 4
 
     def one_roi(roi, bid):
         rx1, ry1, rx2, ry2 = _roi_bounds(roi, scale)
@@ -178,10 +183,11 @@ def _yolo_box(ins, attrs):
 
     img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
     img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
-    x1 = (bx - bw / 2) * img_w
-    y1 = (by - bh / 2) * img_h
-    x2 = (bx + bw / 2) * img_w
-    y2 = (by + bh / 2) * img_h
+    # clamp to image bounds like the reference kernel
+    x1 = jnp.clip((bx - bw / 2) * img_w, 0.0, img_w - 1.0)
+    y1 = jnp.clip((by - bh / 2) * img_h, 0.0, img_h - 1.0)
+    x2 = jnp.clip((bx + bw / 2) * img_w, 0.0, img_w - 1.0)
+    y2 = jnp.clip((by + bh / 2) * img_h, 0.0, img_h - 1.0)
     boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(n, -1, 4)
     scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
     return {"Boxes": [boxes], "Scores": [scores]}
@@ -193,8 +199,11 @@ def _box_clip(ins, attrs):
     Input [.., 4], ImInfo [n, 3] (h, w, scale)."""
     boxes = _x(ins, "Input")
     im_info = _x(ins, "ImInfo")
-    h = im_info[0, 0] / im_info[0, 2] - 1.0
-    w = im_info[0, 1] / im_info[0, 2] - 1.0
+    # per-image bounds: ImInfo rows are (h, w, scale)
+    h = (im_info[:, 0] / im_info[:, 2] - 1.0).reshape(
+        (-1,) + (1,) * (boxes.ndim - 2))
+    w = (im_info[:, 1] / im_info[:, 2] - 1.0).reshape(
+        (-1,) + (1,) * (boxes.ndim - 2))
     x1 = jnp.clip(boxes[..., 0], 0, w)
     y1 = jnp.clip(boxes[..., 1], 0, h)
     x2 = jnp.clip(boxes[..., 2], 0, w)
@@ -245,6 +254,7 @@ def _multiclass_nms(ins, attrs):
     nms_thresh = float(attrs.get("nms_threshold", 0.3))
     nms_top_k = int(attrs.get("nms_top_k", 64))
     keep_top_k = int(attrs.get("keep_top_k", 16))
+    background = int(attrs.get("background_label", 0))
     n, m, _ = bboxes.shape
     ncls = scores.shape[1]
     nms_top_k = min(nms_top_k, m)
@@ -252,12 +262,18 @@ def _multiclass_nms(ins, attrs):
     def one_image(boxes, sc):
         all_scores, all_labels, all_boxes = [], [], []
         for c in range(ncls):
+            if c == background:
+                continue
             s = jnp.where(sc[c] > score_thresh, sc[c], 0.0)
             order, keep = _nms_keep(boxes, s, nms_thresh, nms_top_k)
             kept_s = jnp.where(keep, s[order], 0.0)
             all_scores.append(kept_s)
             all_labels.append(jnp.full((nms_top_k,), c, jnp.float32))
             all_boxes.append(boxes[order])
+        if not all_scores:  # every class was background
+            return jnp.concatenate(
+                [jnp.full((keep_top_k, 1), -1.0),
+                 jnp.zeros((keep_top_k, 5))], axis=1)
         cs = jnp.concatenate(all_scores)
         cl = jnp.concatenate(all_labels)
         cb = jnp.concatenate(all_boxes, axis=0)
@@ -327,27 +343,26 @@ def _bipartite_match(ins, attrs):
     m, n = dist.shape
 
     def body(_, state):
-        match, matched_r, matched_c, d = state
+        col_match, d = state
         idx = jnp.argmax(d)
         r, c = idx // n, idx % n
         ok = d[r, c] > 0
-        match = jnp.where(ok, match.at[r].set(c), match)
-        matched_r = jnp.where(ok, matched_r.at[r].set(True), matched_r)
-        matched_c = jnp.where(ok, matched_c.at[c].set(True), matched_c)
+        col_match = jnp.where(ok, col_match.at[c].set(r), col_match)
         d = jnp.where(ok, d.at[r, :].set(-1.0).at[:, c].set(-1.0), d)
-        return match, matched_r, matched_c, d
+        return col_match, d
 
-    match0 = jnp.full((m,), -1, jnp.int32)
-    state = (match0, jnp.zeros((m,), bool), jnp.zeros((n,), bool),
-             dist.astype(jnp.float32))
-    match, _, _, _ = jax.lax.fori_loop(0, min(m, n), body, state)
+    # Reference semantics (bipartite_match_op.cc): [1, n] per-COLUMN
+    # matched ROW indices.
+    col0 = jnp.full((n,), -1, jnp.int32)
+    col_match, _ = jax.lax.fori_loop(
+        0, min(m, n), body, (col0, dist.astype(jnp.float32)))
     matched_dist = jnp.where(
-        match >= 0,
-        jnp.take_along_axis(dist, jnp.maximum(match, 0)[:, None],
-                            axis=1)[:, 0],
+        col_match >= 0,
+        jnp.take_along_axis(
+            dist, jnp.maximum(col_match, 0)[None, :], axis=0)[0],
         0.0,
     )
-    return {"ColToRowMatchIndices": [match[None]],
+    return {"ColToRowMatchIndices": [col_match[None]],
             "ColToRowMatchDist": [matched_dist[None]]}
 
 

@@ -218,7 +218,7 @@ def test_multiclass_nms_suppresses_overlaps():
     scores = np.array([[[0.9, 0.85, 0.6]]], np.float32)  # one class
     h = OpHarness("multiclass_nms", {"BBoxes": boxes, "Scores": scores},
                   attrs={"nms_threshold": 0.5, "keep_top_k": 3,
-                         "score_threshold": 0.1})
+                         "score_threshold": 0.1, "background_label": -1})
     out = np.asarray(_run(h)["Out"][0])
     labels = out[0, :, 0]
     kept = labels >= 0
@@ -245,8 +245,10 @@ def test_bipartite_match_greedy():
                   out_slots=("ColToRowMatchIndices", "ColToRowMatchDist"))
     res = _run(h)
     match = np.asarray(res["ColToRowMatchIndices"][0])[0]
-    # greedy: (2,1)=0.95 first, then (0,0)=0.9
-    assert match[2] == 1 and match[0] == 0 and match[1] == -1
+    # per-COLUMN matched rows (reference semantics): greedy picks
+    # (row 2, col 1)=0.95 first, then (row 0, col 0)=0.9
+    assert match.shape == (2,)
+    assert match[1] == 2 and match[0] == 0
 
 
 def test_affine_grid_identity():
@@ -351,7 +353,8 @@ def test_lstm_unit_step():
     def sig(a):
         return 1 / (1 + np.exp(-a))
 
-    i, f, g, o = x[:, :4], x[:, 4:8], x[:, 8:12], x[:, 12:]
+    # reference lstm_unit gate order: (i, f, o, g)
+    i, f, o, g = x[:, :4], x[:, 4:8], x[:, 8:12], x[:, 12:]
     c_new = sig(f) * c + sig(i) * np.tanh(g)
     np.testing.assert_allclose(np.asarray(res["C"][0]), c_new, atol=1e-5)
     np.testing.assert_allclose(np.asarray(res["H"][0]),
