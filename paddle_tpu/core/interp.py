@@ -94,7 +94,7 @@ def set_amp_active(flag: bool):
 # time. An ``SpmdCtx`` or None.
 SpmdCtx = collections.namedtuple(
     "SpmdCtx", ["mesh", "context_axis", "table_axis", "data_axis",
-                "expert_axis"]
+                "expert_axis", "pipe_axis", "pipe_micro"]
 )
 
 _SPMD_CTX: contextvars.ContextVar = contextvars.ContextVar(
@@ -120,6 +120,7 @@ def spmd_ctx_scope(strategy):
         strategy.context_axis
         or strategy.table_axis
         or getattr(strategy, "expert_axis", None)
+        or getattr(strategy, "pipe_axis", None)
     ):
         ctx = SpmdCtx(
             mesh=strategy.mesh,
@@ -127,6 +128,8 @@ def spmd_ctx_scope(strategy):
             table_axis=strategy.table_axis,
             data_axis=strategy.data_axis,
             expert_axis=getattr(strategy, "expert_axis", None),
+            pipe_axis=getattr(strategy, "pipe_axis", None),
+            pipe_micro=getattr(strategy, "pipe_micro", None),
         )
     tok = _SPMD_CTX.set(ctx)
     try:

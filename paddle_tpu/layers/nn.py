@@ -36,6 +36,7 @@ __all__ = [
     "bilinear_tensor_product", "nce", "switch_moe",
     "roi_align", "roi_pool", "lrn", "spp", "affine_grid", "multiclass_nms",
     "yolo_box", "sequence_conv", "add_position_encoding", "conv3d",
+    "spectral_norm",
 ]
 
 
@@ -1321,14 +1322,18 @@ def affine_grid(theta, out_shape, name=None):
 
 
 def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
-                   keep_top_k=16, nms_threshold=0.3, name=None):
+                   keep_top_k=16, nms_threshold=0.3, background_label=0,
+                   name=None):
     """Static-shape multiclass NMS: [n, keep_top_k, 6] rows of
     (label, score, box), label -1 padding (reference:
-    layers/detection.py multiclass_nms, LoD output redesigned away)."""
+    layers/detection.py multiclass_nms, LoD output redesigned away).
+    ``background_label``: class skipped entirely (reference default 0;
+    pass -1 to keep every class, e.g. single-class detectors)."""
     return _simple_op_layer(
         "multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
         {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
-         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold},
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+         "background_label": background_label},
         name=name)
 
 
@@ -1340,6 +1345,40 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
         {"anchors": list(anchors), "class_num": class_num,
          "conf_thresh": conf_thresh, "downsample_ratio": downsample_ratio},
         out_slots=["Boxes", "Scores"], name=name)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectrally-normalized view of ``weight`` (reference: layers/nn.py
+    spectral_norm). Creates persistable U/V power-iteration vectors and
+    declares the op's UOut/VOut outputs so the iteration state advances
+    across steps (the batch_norm MeanOut/VarianceOut pattern)."""
+    from paddle_tpu.initializer import NormalInitializer
+
+    helper = LayerHelper("spectral_norm", name=name)
+    shape = weight.shape
+    h = int(shape[dim])
+    w_elems = 1
+    for s_ in shape:
+        w_elems *= int(s_)
+    w_dim = w_elems // h
+    u = helper.create_parameter(
+        ParamAttr(name=unique_name.generate(f"{helper.name}.u"),
+                  trainable=False),
+        shape=[h], dtype=weight.dtype,
+        default_initializer=NormalInitializer(0.0, 1.0))
+    v = helper.create_parameter(
+        ParamAttr(name=unique_name.generate(f"{helper.name}.v"),
+                  trainable=False),
+        shape=[w_dim], dtype=weight.dtype,
+        default_initializer=NormalInitializer(0.0, 1.0))
+    out = helper.create_variable_for_type_inference(dtype=weight.dtype)
+    helper.append_op(
+        "spectral_norm",
+        inputs={"Weight": weight, "U": u, "V": v},
+        outputs={"Out": out, "UOut": u.name, "VOut": v.name},
+        attrs={"dim": dim, "power_iters": power_iters, "eps": eps},
+    )
+    return out
 
 
 def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,

@@ -649,9 +649,14 @@ def _dec_weight_specs(cfg):
 
 
 def _layer_scan(x, cfg, specs, body_fn, stack_prefix, is_test,
-                captured_extra=()):
+                batch_vars=()):
     """Run ``body_fn(x_var, weights)`` once per layer via the scan op,
-    with each weight kind stacked [n_layer, ...] and scanned."""
+    with each weight kind stacked [n_layer, ...] and scanned.
+
+    ``batch_vars``: names of captured vars with the carry's batch dim
+    (attention biases, the encoder output) — under a pipeline strategy
+    these must be microbatched in step with the activation stream
+    (scan attr ``stream_names``)."""
     from paddle_tpu.layer_helper import LayerHelper
     from paddle_tpu.layers.control_flow import _captured_names
 
@@ -714,6 +719,11 @@ def _layer_scan(x, cfg, specs, body_fn, stack_prefix, is_test,
             "state_out_names": [x_out.name],
             "y_names": [],
             "captured_names": captured,
+            # one scan step per LAYER with a single carried activation:
+            # eligible for the GPipe schedule under a strategy pipe_axis
+            "pipelinable": True,
+            "stream_names": [n for n in captured
+                             if n in set(batch_vars)],
         },
     )
     return final
@@ -750,7 +760,8 @@ def build_scan(cfg: Optional[TransformerConfig] = None,
         return _w_drop_add(ff, x, cfg, is_test)
 
     enc = _layer_scan(enc_in, cfg, _enc_weight_specs(cfg), enc_body,
-                      "enc_stack", is_test)
+                      "enc_stack", is_test,
+                      batch_vars=(enc_bias.name,))
     enc = _ln(enc, "enc_post")
 
     dec_in = _embed(trg, cfg.trg_vocab_size, cfg, "trg_emb.w", "trg_pos.w",
@@ -787,7 +798,9 @@ def build_scan(cfg: Optional[TransformerConfig] = None,
         return _w_drop_add(ff, x, cfg, is_test)
 
     dec = _layer_scan(dec_in, cfg, _dec_weight_specs(cfg), dec_body,
-                      "dec_stack", is_test)
+                      "dec_stack", is_test,
+                      batch_vars=(dec_self_bias.name, enc_bias.name,
+                                  enc.name))
     dec = _ln(dec, "dec_post")
 
     logits, token_count, loss = _loss_head(dec, lbl, trg_pad, cfg)

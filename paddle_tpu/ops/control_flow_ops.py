@@ -161,6 +161,20 @@ def _scan(ins, attrs, rng=None):
 
     init_dtypes = [jnp.result_type(v) for v in init]
 
+    # Pipeline parallelism: a scan marked ``pipelinable`` (scan-over-layers
+    # model builds — one step per LAYER, carry = the activation stream)
+    # runs the GPipe microbatch schedule over the strategy's pipe axis
+    # instead of lax.scan: same math, layers spread one-per-rank with the
+    # stacked weights sharded P(pipe) (parallel/pipeline.py). Time-scans
+    # (RNNs) are never pipelined — they lack the marker.
+    if attrs.get("pipelinable", False):
+        ctx = interp.spmd_ctx()
+        if ctx is not None and ctx.pipe_axis is not None:
+            return _scan_as_gpipe(
+                ctx, sub_ops, xs, init, cap_vals, cap_names, x_names,
+                s_in, s_out, y_names, init_dtypes, reverse, rng, amp,
+                list(attrs.get("stream_names", [])))
+
     def body(carry, step):
         i, xt = step
         env = _sub_env(cap_names, cap_vals)
@@ -180,3 +194,74 @@ def _scan(ins, attrs, rng=None):
     steps = (jnp.arange(n_steps, dtype=jnp.int32), tuple(xs))
     final, ys = lax.scan(body, tuple(init), steps, reverse=reverse)
     return {"Y": list(ys), "FinalState": list(final)}
+
+
+def _scan_as_gpipe(ctx, sub_ops, xs, init, cap_vals, cap_names, x_names,
+                   s_in, s_out, y_names, init_dtypes, reverse, rng, amp,
+                   stream_names):
+    """Run a pipelinable layer-scan as a GPipe schedule (see _scan)."""
+    from paddle_tpu.parallel import pipeline as pp
+
+    n_stages = ctx.mesh.shape[ctx.pipe_axis]
+    if len(init) != 1 or y_names:
+        raise ValueError(
+            "pipeline strategy: a pipelinable scan must carry exactly one "
+            "activation stream and emit no per-step outputs "
+            f"(got {len(init)} carries, {len(y_names)} outputs)"
+        )
+    if not xs or int(xs[0].shape[0]) != n_stages:
+        raise ValueError(
+            f"pipeline strategy: the scan has {0 if not xs else int(xs[0].shape[0])} "
+            f"stacked layers but the pipe axis '{ctx.pipe_axis}' has "
+            f"{n_stages} ranks; they must match (one layer per rank)"
+        )
+    if reverse:
+        raise ValueError("pipeline strategy: reverse layer-scan unsupported")
+
+    # Captured values the BUILDER declared batch-shaped (attention biases,
+    # the encoder output — scan attr ``stream_names``) are microbatched in
+    # step with the activation stream; everything else closes over the
+    # stage body unchanged. Declared, not inferred: a replicated constant
+    # whose leading dim coincidentally equals the batch size must NOT be
+    # sliced.
+    b = int(init[0].shape[0])
+    declared = set(stream_names)
+    stream_idx = [i for i, n in enumerate(cap_names) if n in declared]
+    for i in stream_idx:
+        v = cap_vals[i]
+        if not (hasattr(v, "ndim") and v.ndim >= 1 and v.shape[0] == b):
+            raise ValueError(
+                f"pipeline strategy: declared stream '{cap_names[i]}' "
+                f"does not have the carry's batch dim {b} "
+                f"(shape {getattr(v, 'shape', None)})"
+            )
+    stream_names = [cap_names[i] for i in stream_idx]
+    const_pairs = [
+        (n, v) for i, (n, v) in enumerate(zip(cap_names, cap_vals))
+        if i not in stream_idx
+    ]
+
+    def stage(params, act, *streams, micro_idx):
+        env = {n: v for n, v in const_pairs}
+        env.update(zip(stream_names, streams))
+        env.update(zip(s_in, (act,)))
+        env.update(zip(x_names, params))
+        # layer key: the layer index IS the pipe rank (matching the
+        # lax.scan path's fold_in(rng, step)); the microbatch index folds
+        # in too so microbatches draw INDEPENDENT dropout masks — the
+        # full-batch lax.scan mask differs row to row.
+        key = None
+        if rng is not None:
+            key = jax.random.fold_in(
+                jax.random.fold_in(rng, lax.axis_index(ctx.pipe_axis)),
+                micro_idx)
+        interp.exec_ops(sub_ops, env, key=key, amp=amp)
+        return env[s_out[0]].astype(init_dtypes[0])
+
+    out = pp.gpipe(
+        stage, tuple(xs), init[0], ctx.mesh, pipe_axis=ctx.pipe_axis,
+        n_micro=ctx.pipe_micro,
+        batch_streams=tuple(cap_vals[i] for i in stream_idx),
+        with_micro_idx=True,
+    )
+    return {"Y": [], "FinalState": [out]}

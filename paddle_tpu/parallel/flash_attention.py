@@ -48,9 +48,12 @@ DEFAULT_Q_BLOCK = 256
 DEFAULT_K_BLOCK = 256
 _NEG_INF = -1e30
 
-# Soft cap on the f32 score block (h * bq * bk * 4B); bq halves until the
-# block fits alongside q/k/v/acc in ~16MB VMEM.
-_SCORE_VMEM_BYTES = 8 * 2**20
+# Soft cap on the f32 score block (h * bq * bk * 4B). Mosaic sums ALL of
+# a kernel's score-sized temps on its ~16MB scoped-vmem stack (the dkv
+# kernel holds ~6 of them plus casts and scratch), so the per-block cap
+# must stay well under limit/6 — 1.5MB lands bq=128 at h=8, bk=256,
+# which compiles with a [*, tq, tk] bias at t=1024 and beyond.
+_SCORE_VMEM_BYTES = 3 * 2**19
 
 # Test hook: run the Pallas kernels in interpreter mode on CPU so the
 # blocked online-softmax path itself is exercised by the pytest suite
@@ -79,8 +82,10 @@ def _dropout_mask(p_keep: float, shape):
 def _pick_blocks(h, tq, tk, q_block, k_block):
     bq = min(q_block, tq)
     bk = min(k_block, tk)
-    while h * bq * bk * 4 > _SCORE_VMEM_BYTES and bq > 128:
+    while h * bq * bk * 4 > _SCORE_VMEM_BYTES and bq > 64:
         bq //= 2
+    while h * bq * bk * 4 > _SCORE_VMEM_BYTES and bk > 128:
+        bk //= 2
     return bq, bk
 
 

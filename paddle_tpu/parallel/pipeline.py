@@ -29,11 +29,13 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _gpipe_local(params, x_micro, *, fn: Callable, axis: str,
-                 n_micro: int):
+def _gpipe_local(params, x_micro, streams, *, fn: Callable, axis: str,
+                 n_micro: int, with_micro_idx: bool = False):
     """Per-rank body. params: this rank's stage params (leading stage axis
     already sliced away by shard_map); x_micro: [n_micro, mb, ...]
-    microbatched input (replicated; only rank 0 reads it)."""
+    microbatched input (replicated; only rank 0 reads it); streams:
+    tuple of [n_micro, mb, ...] per-microbatch side inputs every stage
+    reads for ITS current microbatch (attention biases etc.)."""
     n_stages = lax.psum(1, axis)
     rank = lax.axis_index(axis)
     total = n_micro + n_stages - 1
@@ -50,7 +52,15 @@ def _gpipe_local(params, x_micro, *, fn: Callable, axis: str,
             x_micro, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
         )
         x_in = jnp.where(rank == 0, feed, incoming)
-        y = fn(params, x_in)
+        mb_clip = jnp.clip(mb_idx, 0, n_micro - 1)
+        stream_t = tuple(
+            lax.dynamic_index_in_dim(sm, mb_clip, axis=0, keepdims=False)
+            for sm in streams
+        )
+        if with_micro_idx:
+            y = fn(params, x_in, *stream_t, micro_idx=mb_clip)
+        else:
+            y = fn(params, x_in, *stream_t)
         y = jnp.where(active, y, jnp.zeros_like(y))
         # last stage banks its result at the microbatch's slot
         write_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
@@ -88,15 +98,23 @@ def gpipe(
     mesh: Mesh,
     pipe_axis: str = "pipe",
     n_micro: Optional[int] = None,
+    batch_streams=(),
+    with_micro_idx: bool = False,
 ):
     """Run ``x`` through ``n_stages`` stages pipelined over ``pipe_axis``.
 
-    - ``fn(params_i, x_mb) -> y_mb`` — one stage's computation, shape
-      preserving.
+    - ``fn(params_i, x_mb, *stream_mbs) -> y_mb`` — one stage's
+      computation, shape preserving in ``x_mb``.
     - ``stage_params`` — pytree whose leaves have a leading ``n_stages``
       axis (sharded onto the pipe axis; each rank holds one slice).
     - ``x`` — [B, ...] global batch; split into ``n_micro`` microbatches
       (default: one per stage).
+    - ``batch_streams`` — [B, ...] side inputs every stage reads for its
+      current microbatch (attention masks/biases); microbatched in step
+      with ``x``.
+    - ``with_micro_idx`` — pass the stage's current microbatch index as a
+      ``micro_idx`` kwarg (stochastic stages fold it into their PRNG key
+      so microbatches draw independent randomness).
     Returns [B, ...] outputs (replicated over the pipe axis).
     """
     n_stages = mesh.shape[pipe_axis]
@@ -105,24 +123,29 @@ def gpipe(
     if b % n_micro != 0:
         raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
     x_m = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+    streams_m = tuple(
+        sv.reshape((n_micro, b // n_micro) + sv.shape[1:])
+        for sv in batch_streams
+    )
 
     param_specs = jax.tree.map(
         lambda p: P(pipe_axis, *([None] * (p.ndim - 1))), stage_params
     )
 
-    def local(params, x_micro):
+    def local(params, x_micro, streams):
         # shard_map slices the stage axis to length 1; drop it
         params = jax.tree.map(lambda p: p[0], params)
         return _gpipe_local(
-            params, x_micro, fn=fn, axis=pipe_axis, n_micro=n_micro
+            params, x_micro, streams, fn=fn, axis=pipe_axis,
+            n_micro=n_micro, with_micro_idx=with_micro_idx
         )
 
     out = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs, P()),
+        in_specs=(param_specs, P(), P()),
         out_specs=P(),
-    )(stage_params, x_m)
+    )(stage_params, x_m, streams_m)
     return out.reshape((b,) + x.shape[1:])
 
 

@@ -52,6 +52,8 @@ class DistributedStrategy:
         context_axis: Optional[str] = None,
         table_axis: Optional[str] = None,
         expert_axis: Optional[str] = None,
+        pipe_axis: Optional[str] = None,
+        pipe_micro: Optional[int] = None,
     ):
         self.mesh = mesh
         self.data_axis = data_axis if data_axis in mesh.axis_names else None
@@ -74,6 +76,14 @@ class DistributedStrategy:
         self.expert_axis = (
             expert_axis if expert_axis in mesh.axis_names else None
         )
+        # Pipeline parallelism: pipelinable scan ops (scan-over-layers
+        # model builds) run the GPipe schedule over this axis, one layer
+        # per rank (parallel/pipeline.py). pipe_micro = microbatch count
+        # (default: one per stage).
+        self.pipe_axis = (
+            pipe_axis if pipe_axis in mesh.axis_names else None
+        )
+        self.pipe_micro = pipe_micro
 
     def spec_for(self, name: str) -> P:
         # Scalar optimizer state (Adam beta pows, LR) can never shard;
@@ -117,6 +127,13 @@ def moe_rules(expert_axis: str = "expert") -> List[ShardingRule]:
         ShardingRule(r"_experts\.(w1|b1|w2|b2)(_|$)", P(e)),
         ShardingRule(r"_gate\.w(_|$)", P()),
     ]
+
+
+def pipeline_rules(pipe_axis: str = "pipe") -> List[ShardingRule]:
+    """Stacked-layer weights ([L, ...] from scan-over-layers builds,
+    ``*_stacked`` naming) shard one layer per pipe rank; everything else
+    replicates (combine with transformer_rules/data axis as needed)."""
+    return [ShardingRule(r"_stacked(_|$)", P(pipe_axis))]
 
 
 def transformer_rules(model_axis: str = "model") -> List[ShardingRule]:

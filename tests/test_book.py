@@ -1,0 +1,207 @@
+"""The "book" acceptance chapters the round-1 suite didn't cover.
+
+Reference: python/paddle/fluid/tests/book/ trains each chapter's model to
+a convergence threshold and round-trips save/load_inference_model
+(SURVEY.md section 4.6 — the reference's acceptance suite). fit_a_line
+and recognize_digits live in test_train.py; this file adds
+image_classification (cifar10), understand_sentiment (imdb),
+word2vec, recommender_system, and machine_translation.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset, io, layers, reader
+from paddle_tpu.data_feeder import DataFeeder
+
+
+def _train_loop(main, startup, feeder, loss, batches, exe=None):
+    exe = exe or fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for batch in batches:
+        out = exe.run(main, feed=feeder.feed(batch), fetch_list=[loss])
+        losses.append(float(out[0]))
+    return exe, losses
+
+
+def _pad(seqs, maxlen, pad=0):
+    out = np.full((len(seqs), maxlen), pad, np.int64)
+    for i, s in enumerate(seqs):
+        out[i, : min(len(s), maxlen)] = s[:maxlen]
+    return out
+
+
+def test_book_image_classification_cifar(tmp_path):
+    """book ch3: a small conv net on cifar10 (reference:
+    tests/book/test_image_classification.py)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("pixel", shape=[3 * 32 * 32], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        x = layers.reshape(img, [0, 3, 32, 32])
+        x = layers.conv2d(x, 16, 3, padding=1, act="relu")
+        x = layers.pool2d(x, 2, pool_stride=2)
+        x = layers.conv2d(x, 32, 3, padding=1, act="relu")
+        x = layers.pool2d(x, 2, pool_stride=2)
+        logits = layers.fc(layers.flatten(x), 10)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(logits, label)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+    feeder = DataFeeder([img, label])
+    batches = list(reader.batch(dataset.cifar.train10(), 64)())[:80]
+    exe, losses = _train_loop(main, startup, feeder, loss, batches)
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.9, losses[::16]
+
+    d = str(tmp_path / "cifar_model")
+    io.save_inference_model(d, ["pixel"], [logits], exe, main)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    prog2, feed_names, fetch_vars = io.load_inference_model(d, exe2)
+    fd = feeder.feed(batches[0])
+    ref = exe.run(test_prog, feed=fd, fetch_list=[logits])[0]
+    got = exe2.run(prog2, feed={"pixel": fd["pixel"]},
+                   fetch_list=fetch_vars)[0]
+    np.testing.assert_allclose(ref, got, rtol=1e-4, atol=1e-5)
+
+
+def test_book_understand_sentiment_imdb():
+    """book ch6: embedding + sequence pooling sentiment classifier
+    (reference: tests/book/test_understand_sentiment.py)."""
+    vocab, maxlen = 5148, 64
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = layers.data("words", shape=[maxlen], dtype="int64")
+        label = layers.data("label", shape=[1], dtype="int64")
+        emb = layers.embedding(words, size=[vocab, 32])
+        pooled = layers.sequence_pool(emb, "average")
+        h = layers.fc(pooled, 32, act="relu", num_flatten_dims=1)
+        logits = layers.fc(h, 2, num_flatten_dims=1)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(logits, label)
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    def batches(rdr, n):
+        out = []
+        buf_w, buf_l = [], []
+        for ids, lbl in rdr():
+            buf_w.append(ids)
+            buf_l.append(lbl)
+            if len(buf_w) == 32:
+                out.append({"words": _pad(buf_w, maxlen),
+                            "label": np.asarray(buf_l, np.int64)[:, None]})
+                buf_w, buf_l = [], []
+            if len(out) >= n:
+                break
+        return out
+
+    train_b = batches(dataset.imdb.train(), 60)
+    losses = [
+        float(exe.run(main, feed=fd, fetch_list=[loss])[0])
+        for fd in train_b
+    ]
+    accs = [
+        float(exe.run(test_prog, feed=fd, fetch_list=[acc])[0])
+        for fd in batches(dataset.imdb.test(), 8)
+    ]
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.9
+    assert np.mean(accs) > 0.6, accs
+
+
+def test_book_word2vec():
+    """book ch4: N-gram next-word prediction over shared embeddings
+    (reference: tests/book/test_word2vec.py)."""
+    vocab, emb_dim, n = 128, 16, 4
+    r = np.random.RandomState(5)
+    # synthetic corpus with learnable bigram structure
+    trans = r.permutation(vocab)
+    corpus = [0]
+    for _ in range(4000):
+        nxt = trans[corpus[-1]] if r.rand() < 0.8 else r.randint(vocab)
+        corpus.append(int(nxt))
+    corpus = np.asarray(corpus, np.int64)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ctx = layers.data("ctx", shape=[n], dtype="int64")
+        nxt = layers.data("next", shape=[1], dtype="int64")
+        embs = layers.embedding(
+            ctx, size=[vocab, emb_dim],
+            param_attr=fluid.ParamAttr(name="shared_emb.w"))
+        concat = layers.reshape(embs, [0, n * emb_dim])
+        h = layers.fc(concat, 64, act="relu", num_flatten_dims=1)
+        logits = layers.fc(h, vocab, num_flatten_dims=1)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, nxt))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for step in range(120):
+        i = (step * 32) % (len(corpus) - n - 33)
+        windows = np.stack([corpus[i + k: i + k + n] for k in range(32)])
+        nxts = corpus[i + n: i + n + 32][:, None]
+        out = exe.run(main, feed={"ctx": windows, "next": nxts},
+                      fetch_list=[loss])
+        losses.append(float(out[0]))
+    # ppl must drop well below uniform (log 128 ~= 4.85)
+    assert np.mean(losses[-10:]) < 3.0, losses[::24]
+
+
+def test_book_recommender_system():
+    """book ch5: dot-product factorization of a user/item rating matrix
+    (reference: tests/book/test_recommender_system.py)."""
+    users, items, k = 64, 96, 8
+    r = np.random.RandomState(7)
+    u_lat = r.normal(0, 1, (users, k))
+    i_lat = r.normal(0, 1, (items, k))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        uid = layers.data("uid", shape=[1], dtype="int64")
+        iid = layers.data("iid", shape=[1], dtype="int64")
+        rating = layers.data("rating", shape=[1], dtype="float32")
+        ue = layers.reshape(layers.embedding(uid, size=[users, 16]), [0, 16])
+        ie = layers.reshape(layers.embedding(iid, size=[items, 16]), [0, 16])
+        uf = layers.fc(ue, 16, num_flatten_dims=1)
+        itf = layers.fc(ie, 16, num_flatten_dims=1)
+        pred = layers.reduce_sum(
+            layers.elementwise_mul(uf, itf), dim=1, keep_dim=True)
+        loss = layers.reduce_mean(layers.square_error_cost(pred, rating))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for step in range(150):
+        us = r.randint(0, users, (64, 1)).astype(np.int64)
+        its = r.randint(0, items, (64, 1)).astype(np.int64)
+        ratings = np.sum(u_lat[us[:, 0]] * i_lat[its[:, 0]],
+                         axis=1, keepdims=True).astype(np.float32)
+        out = exe.run(main, feed={"uid": us, "iid": its, "rating": ratings},
+                      fetch_list=[loss])
+        losses.append(float(out[0]))
+    assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.5, losses[::30]
+
+
+def test_book_machine_translation(tmp_path):
+    """book ch8: seq2seq NMT trains and greedy-decodes (reference:
+    tests/book/test_machine_translation.py). Uses the zoo's LSTM
+    seq2seq-with-attention on the wmt16 synthetic reader."""
+    from paddle_tpu.models import seq2seq
+
+    cfg = seq2seq.Seq2SeqConfig(src_vocab_size=200, trg_vocab_size=200,
+                                hidden_dim=64, embed_dim=32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        model = seq2seq.build(cfg)
+        fluid.optimizer.Adam(5e-3).minimize(model["loss"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for step in range(90):
+        fd = seq2seq.make_batch(cfg, 16, 12, 12, seed=step % 6)
+        out = exe.run(main, feed=fd, fetch_list=[model["loss"]])
+        losses.append(float(out[0]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7, losses[::15]
