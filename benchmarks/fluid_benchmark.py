@@ -13,8 +13,9 @@ parallelism over the visible devices (the reference's
     python benchmarks/fluid_benchmark.py --model machine_translation \
         --parallel
 
-Models: mnist, resnet, se_resnext, vgg, machine_translation (LSTM NMT
-seq2seq), transformer, bert, deepfm.
+Models: mnist, resnet, se_resnext, vgg, stacked_dynamic_lstm (IMDB
+sentiment), machine_translation (LSTM NMT seq2seq), transformer, bert,
+deepfm.
 """
 
 from __future__ import annotations
@@ -55,6 +56,13 @@ def build_model(name, args):
         model = mod.get_model(data_shape=(3, 224, 224), class_dim=1000)
         feeds = lambda s: {"data": _synth((b, 3, 224, 224), seed=s),
                            "label": _synth((b, 1), "int64", 0, 1000, s)}
+        return feeds, model["loss"], b
+    if name in ("stacked_dynamic_lstm", "stacked_lstm"):
+        from paddle_tpu.models import stacked_lstm
+
+        cfg = stacked_lstm.StackedLSTMConfig(max_len=args.seq_len)
+        model = stacked_lstm.build(cfg)
+        feeds = lambda s: stacked_lstm.make_batch(cfg, b, seed=s)
         return feeds, model["loss"], b
     if name == "machine_translation":
         from paddle_tpu.models import seq2seq

@@ -32,6 +32,7 @@ from paddle_tpu import (  # noqa: F401
     debugger,
     inference,
     install_check,
+    passes,
     transpiler,
 )
 from paddle_tpu.dataset_api import DatasetFactory  # noqa: F401

@@ -36,7 +36,7 @@ __all__ = [
     "bilinear_tensor_product", "nce", "switch_moe",
     "roi_align", "roi_pool", "lrn", "spp", "affine_grid", "multiclass_nms",
     "yolo_box", "sequence_conv", "add_position_encoding", "conv3d",
-    "spectral_norm",
+    "spectral_norm", "hsigmoid", "sample_logits",
 ]
 
 
@@ -1432,3 +1432,49 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0,
                "dilations": triple(dilation), "groups": groups})
     out = helper.append_bias_op(out, dim_start=1, dim_end=2)
     return helper.append_activation(out)
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid over a complete binary tree (reference:
+    layers/nn.py hsigmoid / hsigmoid_op.cc). Cost [b, 1]."""
+    helper = LayerHelper("hsigmoid", name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        ParamAttr._to_attr(param_attr),
+        shape=[num_classes - 1, d], dtype=input.dtype)
+    b = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr), shape=[num_classes - 1],
+        dtype=input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    pre = helper.create_variable_for_type_inference(dtype=input.dtype)
+    inputs = {"X": input, "W": w, "Label": label}
+    if b is not None:
+        inputs["Bias"] = b
+    helper.append_op(
+        "hierarchical_sigmoid", inputs=inputs,
+        outputs={"Out": out, "PreOut": pre},
+        attrs={"num_classes": int(num_classes)})
+    return out
+
+
+def sample_logits(logits, label, num_samples, remove_accidental_hits=True,
+                  name=None):
+    """Sampled-softmax logits slice (reference: layers/nn.py
+    sample_logits). Returns (sampled_logits, sampled_label); feed them to
+    softmax_with_cross_entropy."""
+    helper = LayerHelper("sample_logits", name=name)
+    outs = {
+        s: helper.create_variable_for_type_inference(
+            dtype="int64" if s in ("Samples", "SampledLabel") else
+            logits.dtype,
+            stop_gradient=s != "SampledLogits")
+        for s in ("Samples", "Probabilities", "SampledLogits",
+                  "SampledLabel")
+    }
+    helper.append_op(
+        "sample_logits", inputs={"Logits": logits, "Labels": label},
+        outputs=outs,
+        attrs={"num_samples": int(num_samples),
+               "remove_accidental_hits": bool(remove_accidental_hits)})
+    return outs["SampledLogits"], outs["SampledLabel"]

@@ -691,3 +691,87 @@ def _nce(ins, attrs, rng=None):
     pos = jax.nn.log_sigmoid(adj[:, 0])
     negs = jnp.sum(jax.nn.log_sigmoid(-adj[:, 1:]), axis=1)
     return {"Cost": [(-(pos + negs))[:, None]]}
+
+
+@register_op("hierarchical_sigmoid", diff_inputs=("X", "W", "Bias"))
+def _hierarchical_sigmoid(ins, attrs):
+    """Binary-tree sigmoid classifier over log2(C) path nodes (reference:
+    hsigmoid_op.cc with the default complete-tree SimpleCode: leaf code =
+    label + C, ancestors are the code's bit-prefixes). X [b, d],
+    W [C-1, d], Label [b, 1] or [b], Bias [C-1] optional ->
+    Out [b, 1] cost, PreOut [b, max_len] (padded with zeros)."""
+    x, w = _x(ins), _x(ins, "W")
+    label = _x(ins, "Label")
+    bias = _x(ins, "Bias")
+    num_classes = int(attrs["num_classes"])
+    if jnp.ndim(label) > 1:
+        label = jnp.reshape(label, (-1,))
+    code = label.astype(jnp.int32) + num_classes       # [b], in [C, 2C)
+    # exact integer bit length (f32 log2 over-counts near 2^k boundaries
+    # from C ~ 2^20, silently corrupting tree paths): count thresholds
+    length = jnp.sum(
+        (code[:, None] >= jnp.left_shift(
+            jnp.int32(1), jnp.arange(31, dtype=jnp.int32))[None, :]
+         ).astype(jnp.int32),
+        axis=1,
+    )
+    path_len = length - 1                              # internal nodes
+    max_len = int(num_classes).bit_length()
+    pres, losses = [], []
+    for j in range(max_len):
+        # j-th step: ancestor = the (j+1)-bit prefix of the code minus 1
+        # (root first), direction = the next bit (reference SimpleCode:
+        # calc_index/calc_bit)
+        bit_shift = path_len - 1 - j
+        active = bit_shift >= 0
+        safe = jnp.maximum(bit_shift, 0)
+        node = jnp.right_shift(code, safe + 1) - 1     # [b] in [0, C-2]
+        node = jnp.clip(node, 0, num_classes - 2)
+        bit = jnp.bitwise_and(jnp.right_shift(code, safe), 1).astype(x.dtype)
+        pre = jnp.sum(jnp.take(w, node, axis=0) * x, axis=-1)
+        if bias is not None:
+            pre = pre + jnp.take(jnp.reshape(bias, (-1,)), node)
+        # per-node logistic loss: log(1+e^pre) - bit*pre
+        lj = jax.nn.softplus(pre) - bit * pre
+        mask = active.astype(x.dtype)
+        pres.append(pre * mask)
+        losses.append(lj * mask)
+    out = sum(losses)[:, None]
+    pre_out = jnp.stack(pres, axis=1)
+    return {"Out": [out], "PreOut": [pre_out]}
+
+
+@register_op("sample_logits", needs_rng=True,
+             diff_inputs=("Logits",))
+def _sample_logits(ins, attrs, rng=None):
+    """Sampled-softmax helper (reference: sample_logits_op.cc): keep the
+    true-label logits plus ``num_samples`` uniformly sampled classes,
+    subtracting log(q) so softmax over the slice estimates the full one.
+    Logits [b, C], Labels [b, T] -> Samples [b, T+S], Probabilities,
+    SampledLogits [b, T+S], SampledLabel [b, T]."""
+    logits = _x(ins, "Logits")
+    labels = _x(ins, "Labels")
+    s = int(attrs["num_samples"])
+    remove_hits = bool(attrs.get("remove_accidental_hits", True))
+    b, c = logits.shape
+    t = labels.shape[1]
+    labels = labels.astype(jnp.int32)
+    sampled = jax.random.randint(rng, (b, s), 0, c, dtype=jnp.int32)
+    samples = jnp.concatenate([labels, sampled], axis=1)   # [b, t+s]
+    # uniform proposal: q = s / C per draw (with replacement)
+    q = jnp.full((b, t + s), float(s) / c, logits.dtype)
+    picked = jnp.take_along_axis(logits, samples, axis=1)
+    adjusted = picked - jnp.log(q)
+    if remove_hits:
+        # a sampled class equal to the true label would double-count it
+        hit = samples[:, None, t:] == labels[:, :, None]   # [b, t, s]
+        hit_any = jnp.any(hit, axis=1)                     # [b, s]
+        neg = jnp.asarray(-1e20, adjusted.dtype)
+        adjusted = jnp.concatenate(
+            [adjusted[:, :t],
+             jnp.where(hit_any, neg, adjusted[:, t:])], axis=1)
+    sampled_label = jnp.tile(jnp.arange(t, dtype=jnp.int64)[None], (b, 1))
+    return {"Samples": [samples.astype(jnp.int64)],
+            "Probabilities": [q],
+            "SampledLogits": [adjusted],
+            "SampledLabel": [sampled_label]}

@@ -1,0 +1,117 @@
+"""Program-level pass framework.
+
+The TPU-native analog of the reference's IR pass registry
+(reference: paddle/fluid/framework/ir/pass.h + ~45 registered passes).
+Fusion/layout/memory passes are delegated to XLA by design (SURVEY.md
+section 7 phase 4), so the passes that remain are PROGRAM rewrites —
+AMP marking, quantization-aware-training insertion, inference folding,
+pruning — and this module gives them one registry + pipeline API instead
+of ad-hoc entry points:
+
+    from paddle_tpu import passes
+    passes.apply_pass("conv_bn_fuse", program, scope=scope)
+    pm = passes.PassManager(["quant_aware", "amp"])
+    pm.apply(program)
+
+A pass is ``apply(program, scope=None, **kw) -> program`` (mutating in
+place and returning the program; the return value allows rewriting
+passes that build a new Program, e.g. inference pruning).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+_PASS_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    """Decorator registering ``fn(program, scope=None, **kw) -> program``
+    (reference: REGISTER_PASS, framework/ir/pass.h)."""
+
+    def deco(fn):
+        if name in _PASS_REGISTRY:
+            raise ValueError(f"pass '{name}' registered twice")
+        _PASS_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def registered_passes() -> List[str]:
+    return sorted(_PASS_REGISTRY)
+
+
+def get_pass(name: str) -> Callable:
+    if name not in _PASS_REGISTRY:
+        raise KeyError(
+            f"unknown pass '{name}'; registered: {registered_passes()}"
+        )
+    return _PASS_REGISTRY[name]
+
+
+def apply_pass(name: str, program, scope=None, **kw):
+    out = get_pass(name)(program, scope=scope, **kw)
+    return program if out is None else out
+
+
+class PassManager:
+    """Ordered pass pipeline (reference: ir/pass.h PassRegistry usage in
+    details/build_strategy.cc:52-230)."""
+
+    def __init__(self, names: Sequence[str] = ()):
+        self.names = list(names)
+
+    def append(self, name: str) -> "PassManager":
+        self.names.append(name)
+        return self
+
+    def apply(self, program, scope=None, **kw):
+        for n in self.names:
+            program = apply_pass(n, program, scope=scope, **kw)
+        return program
+
+
+# --- built-in passes wrapping the existing rewrites ---
+
+
+@register_pass("conv_bn_fuse")
+def _conv_bn_fuse(program, scope=None, **kw):
+    """Fold inference-mode batch norms into the preceding conv
+    (transpiler.InferenceTranspiler)."""
+    from paddle_tpu.transpiler import InferenceTranspiler
+
+    InferenceTranspiler().transpile(program, scope)
+    return program
+
+
+@register_pass("quant_aware")
+def _quant_aware(program, scope=None, weight_bits=8, activation_bits=8,
+                 **kw):
+    """Insert fake-quant STE ops before matmul/conv inputs
+    (slim.quantization.QuantizationTransformPass)."""
+    from paddle_tpu.slim.quantization import QuantizationTransformPass
+
+    QuantizationTransformPass(
+        weight_bits=weight_bits, activation_bits=activation_bits
+    ).apply(program)
+    return program
+
+
+@register_pass("amp")
+def _amp(program, scope=None, **kw):
+    """Mark the program for bf16 AMP lowering (core/lowering.py reads
+    ``program._amp`` at trace time)."""
+    program._amp = True
+    return program
+
+
+@register_pass("inference_prune")
+def _inference_prune(program, scope=None, targets=None, feeds=None, **kw):
+    """Prune to the inference subgraph reaching ``targets`` (io.py's
+    save_inference_model pruning, exposed as a standalone pass)."""
+    if targets is None:
+        raise ValueError("inference_prune needs targets=[vars or names]")
+    from paddle_tpu import io as _io
+
+    return _io._prune_for_inference(program, feeds or [], targets)

@@ -205,3 +205,29 @@ def test_book_machine_translation(tmp_path):
         out = exe.run(main, feed=fd, fetch_list=[model["loss"]])
         losses.append(float(out[0]))
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7, losses[::15]
+
+
+def test_book_stacked_dynamic_lstm_sentiment():
+    """The reference benchmark's stacked_dynamic_lstm model family
+    (reference: benchmark/fluid/models/stacked_dynamic_lstm.py) trains on
+    the imdb-style synthetic signal."""
+    from paddle_tpu.models import stacked_lstm
+
+    cfg = stacked_lstm.StackedLSTMConfig(
+        vocab_size=512, embed_dim=32, hidden_dim=32, stacked_num=2,
+        max_len=48)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        model = stacked_lstm.build(cfg)
+        fluid.optimizer.Adam(5e-3).minimize(model["loss"])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses, accs = [], []
+    for step in range(60):
+        fd = stacked_lstm.make_batch(cfg, 32, seed=step % 8)
+        out = exe.run(main, feed=fd,
+                      fetch_list=[model["loss"], model["acc"]])
+        losses.append(float(out[0]))
+        accs.append(float(out[1]))
+    assert np.mean(losses[-8:]) < np.mean(losses[:8]) * 0.8, losses[::12]
+    assert np.mean(accs[-8:]) > 0.75, accs[::12]

@@ -1,0 +1,159 @@
+"""Pass framework + large-vocab classifier ops (hsigmoid, sample_logits)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, passes
+
+
+def test_pass_registry_and_manager():
+    assert "conv_bn_fuse" in passes.registered_passes()
+    assert "amp" in passes.registered_passes()
+    main = fluid.Program()
+    out = passes.PassManager(["amp"]).apply(main)
+    assert out._amp is True
+    with pytest.raises(KeyError, match="unknown pass"):
+        passes.apply_pass("nope", main)
+
+
+def test_conv_bn_fuse_pass_matches_transpiler(tmp_path):
+    """The registered pass produces the same program rewrite the
+    transpiler API does (same op-type counts)."""
+    import copy
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+        x = layers.conv2d(img, 4, 3, padding=1, bias_attr=False)
+        x = layers.batch_norm(x, is_test=True)
+        _ = layers.mean(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    n_bn_before = sum(1 for op in main.global_block().ops
+                      if op.type == "batch_norm")
+    passes.apply_pass("conv_bn_fuse", main, scope=scope)
+    n_bn_after = sum(1 for op in main.global_block().ops
+                     if op.type == "batch_norm")
+    assert n_bn_before == 1 and n_bn_after == 0
+
+
+def test_hsigmoid_trains():
+    """log2(C) path-node classifier learns a separable task."""
+    vocab = 32
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[16], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="int64")
+        cost = layers.hsigmoid(x, y, vocab)
+        loss = layers.mean(cost)
+        fluid.optimizer.Adam(5e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    protos = r.normal(0, 2, (vocab, 16)).astype(np.float32)
+    losses = []
+    for step in range(120):
+        lbl = r.randint(0, vocab, (64, 1)).astype(np.int64)
+        xv = protos[lbl[:, 0]] + r.normal(0, 0.1, (64, 16)).astype(
+            np.float32)
+        losses.append(float(exe.run(main, feed={"x": xv, "y": lbl},
+                                    fetch_list=[loss])[0]))
+    # path length ~5 nodes; random init ~5*log(2)=3.47 -> must drop hard
+    assert np.mean(losses[-10:]) < 0.65, losses[::24]
+
+
+def test_hsigmoid_matches_manual_power_of_two():
+    """C=8: every label has a 3-node path; compare against the explicit
+    per-node logistic losses."""
+    import jax
+
+    vocab, d, b = 8, 4, 5
+    r = np.random.RandomState(1)
+    x = r.normal(0, 1, (b, d)).astype(np.float32)
+    w = r.normal(0, 1, (vocab - 1, d)).astype(np.float32)
+    bias = r.normal(0, 1, (vocab - 1,)).astype(np.float32)
+    lbl = np.arange(b).astype(np.int64)[:, None]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[d], dtype="float32")
+        yv = layers.data("y", shape=[1], dtype="int64")
+        cost = layers.hsigmoid(
+            xv, yv, vocab,
+            param_attr=fluid.ParamAttr(
+                name="hs.w",
+                initializer=fluid.initializer.NumpyArrayInitializer(w)),
+            bias_attr=fluid.ParamAttr(
+                name="hs.b",
+                initializer=fluid.initializer.NumpyArrayInitializer(bias)))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    got = exe.run(main, feed={"x": x, "y": lbl}, fetch_list=[cost])[0]
+
+    def softplus(v):
+        return np.log1p(np.exp(v))
+
+    exp = np.zeros((b, 1), np.float32)
+    for i in range(b):
+        code = int(lbl[i, 0]) + vocab          # 4-bit code, 3 path nodes
+        for j in range(3):
+            shift = 2 - j
+            node = (code >> (shift + 1)) - 1
+            bit = (code >> shift) & 1
+            pre = float(x[i] @ w[node] + bias[node])
+            exp[i, 0] += softplus(pre) - bit * pre
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_sample_logits_shapes_and_hits():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        logits = layers.data("logits", shape=[64], dtype="float32")
+        lbl = layers.data("y", shape=[1], dtype="int64")
+        s_logits, s_label = layers.sample_logits(logits, lbl, 16)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(s_logits, s_label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    out = exe.run(
+        main,
+        feed={"logits": r.normal(0, 1, (4, 64)).astype(np.float32),
+              "y": r.randint(0, 64, (4, 1)).astype(np.int64)},
+        fetch_list=[s_logits, loss])
+    assert out[0].shape == (4, 17)  # 1 true + 16 sampled
+    assert np.isfinite(out[1]).all()
+
+
+def test_hsigmoid_large_vocab_boundary():
+    """C=2^20 with boundary labels: integer bit-length must be exact
+    (f32 log2 over-counts near 2^k and corrupted the tree path)."""
+    from paddle_tpu.core.registry import get_op_def
+
+    op = get_op_def("hierarchical_sigmoid")
+    C, d = 1 << 20, 4
+    r = np.random.RandomState(0)
+    x = r.normal(0, 1, (2, d)).astype(np.float32)
+    w = r.normal(0, 1, (C - 1, d)).astype(np.float32)
+    lbl = np.array([[C - 1], [0]], np.int64)
+    out = op.compute({"X": [x], "W": [w], "Label": [lbl], "Bias": [None]},
+                     {"num_classes": C})
+    got = np.asarray(out["Out"][0])
+
+    def softplus(v):
+        return np.log1p(np.exp(v))
+
+    for i, lab in enumerate([C - 1, 0]):
+        code = lab + C
+        length = code.bit_length()
+        exp = 0.0
+        for j in range(length - 1):
+            shift = length - 2 - j
+            node = (code >> (shift + 1)) - 1
+            bit = (code >> shift) & 1
+            pre = float(x[i] @ w[node])
+            exp += softplus(pre) - bit * pre
+        np.testing.assert_allclose(got[i, 0], exp, rtol=1e-4)
