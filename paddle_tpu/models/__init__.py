@@ -7,6 +7,7 @@ from paddle_tpu.models import (  # noqa: F401
     resnet,
     se_resnext,
     seq2seq,
+    stacked_lstm,
     transformer,
     vgg,
 )
