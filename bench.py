@@ -120,11 +120,14 @@ def main():
     ]
     for f in feeds[:2]:
         exe.run(main_prog, feed=f, fetch_list=[model["loss"]])
-    # best of 3 windows: the tunnel adds bursty host-side noise (measured
-    # +-15% between otherwise identical windows); the minimum is the
-    # honest estimate of device throughput
+    # 3x 30-step windows. The tunnel adds bursty host-side noise (measured
+    # +-15% between otherwise identical windows), so the BEST window is the
+    # honest estimate of device throughput and stays the headline `value`;
+    # the mean over all windows is reported alongside so both estimators
+    # are visible in the driver artifact (methodology documented in
+    # BASELINE.md "Measurement methodology").
     steps = 30
-    best = float("inf")
+    windows = []
     loss_v = None
     for w in range(3):
         t0 = time.time()
@@ -136,19 +139,59 @@ def main():
         elapsed = time.time() - t0
         log(f"window {w}: {steps} steps in {elapsed:.2f}s, "
             f"loss={loss_v:.3f}")
-        best = min(best, elapsed)
+        windows.append(elapsed)
+    best = min(windows)
+    mean = sum(windows) / len(windows)
 
     tokens_per_step = batch * SEQ  # target tokens (reference convention)
     tokens_per_sec = tokens_per_step * steps / best
     flops = analytic_flops_per_step(cfg, batch, SEQ, SEQ)
     mfu = (flops * steps / best) / V5E_PEAK_BF16
+    mfu_mean = (flops * steps / mean) / V5E_PEAK_BF16
     log(f"tokens/sec={tokens_per_sec:.0f}, analytic TFLOP/step={flops/1e12:.2f}, MFU={mfu:.3f}")
+
+    # ResNet-50 rides along as a second metric, in a FRESH process: two
+    # co-resident compiled programs contaminate each other's HBM/timing
+    # (see BASELINE.md methodology). Free this process's HBM first —
+    # donated state, staged feeds, compiled executables all pin device
+    # memory the child would otherwise share the chip with.
+    resnet = None
+    if os.environ.get("PT_BENCH_RESNET", "1") == "1":
+        import subprocess
+
+        del feeds
+        fluid.executor.global_scope().clear()
+        exe.close()
+        jax.clear_caches()
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "bench_resnet.py")],
+                capture_output=True, text=True, timeout=900)
+            if out.returncode != 0:
+                log(f"resnet bench rc={out.returncode}, "
+                    f"stderr tail: {out.stderr[-500:]}")
+            for line in out.stdout.splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        resnet = json.loads(line)
+                    except ValueError:
+                        pass  # non-JSON line that happens to start with {
+            log(f"resnet50: {resnet}")
+        except Exception as e:  # never let the rider kill the headline
+            log(f"resnet bench failed: {type(e).__name__}: {e}")
 
     print(json.dumps({
         "metric": "transformer_base_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(mfu / 0.35, 3),
+        "value_mean": round(tokens_per_step * steps / mean, 1),
+        "mfu_best": round(mfu, 4),
+        "mfu_mean": round(mfu_mean, 4),
+        "resnet50": resnet,
     }))
 
 
