@@ -614,17 +614,49 @@ def _use_bthd_small(tq, tk):
 
 
 def _small_dropout(seed_ref, i, jc, hi, shape, p_drop):
-    """Scaled keep mask for (batch i, q-chunk jc, head hi) — keyed
-    absolutely so the forward and backward kernels (same _CQ chunking of
-    tq, same per-head loop) regenerate identical streams. bf16 mask; the
-    bf16 rounding of 1/p_keep (~0.2%) shifts the inverted-dropout scale
-    identically in both directions, so gradients stay exact for the
-    actual forward."""
+    """Scaled keep mask for (batch i, row-block jc, head hi). bf16 mask;
+    the bf16 rounding of 1/p_keep (~0.2%) shifts the inverted-dropout
+    scale identically in both directions, so gradients stay exact for
+    the actual forward. 16-bit random words: RNG throughput is
+    bits-bound (uint32 masks measured 0.165 ms/call extra across
+    fwd+bwd at b=64 t=256 h=8); 1/65536 keep-rate granularity is far
+    below dropout's statistical noise."""
     pltpu.prng_seed(_block_seed(seed_ref[0], i, jc, hi))
     p_keep = 1.0 - p_drop
-    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
-    thresh = jnp.uint32(int(p_keep * float(2**32 - 1)))
+    rows, tk = shape
+    if rows % 2 == 0:
+        # u32->u16 bitcast doubles the SUBLANE (major) dim: (rows//2, tk)
+        # uint32 reinterprets as (rows, tk) uint16. Mosaic can't compare
+        # u16 directly, so widen for the compare — the expensive part
+        # (random-bit generation) is still halved.
+        half = pltpu.prng_random_bits((rows // 2, tk))
+        bits = pltpu.bitcast(half, jnp.uint16).astype(jnp.int32)
+        thresh = jnp.int32(min(int(p_keep * 65536.0), 65535))
+    else:
+        bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+        thresh = jnp.uint32(int(p_keep * float(2**32 - 1)))
     return (bits < thresh).astype(jnp.bfloat16) * jnp.bfloat16(1.0 / p_keep)
+
+
+def _chunked_dropout(seed_ref, i, j, cq, hi, tk, p_drop, key_of_jabs):
+    """(cq, tk) keep mask assembled from 128-row sub-blocks keyed by
+    ABSOLUTE row-block index (via ``key_of_jabs``), so forward and
+    backward kernels regenerate identical streams even when they walk tq
+    with different chunk sizes (the forward uses the widest chunk VMEM
+    allows; the fused backward runs at 128)."""
+    nsub = max(1, cq // _CQ)
+    rows = cq // nsub
+    subs = [
+        _small_dropout(seed_ref, i, key_of_jabs(j * nsub + b), hi,
+                       (rows, tk), p_drop)
+        for b in range(nsub)
+    ]
+    return subs[0] if nsub == 1 else jnp.concatenate(subs, axis=0)
+
+
+def _small_dropout_abs(seed_ref, i, j, cq, hi, tk, p_drop):
+    return _chunked_dropout(seed_ref, i, j, cq, hi, tk, p_drop,
+                            lambda jabs: jabs)
 
 
 # Fixed q-chunk for the single-block kernels: tq is walked in _CQ-row grid
@@ -635,6 +667,20 @@ def _small_dropout(seed_ref, i, jc, hi, shape, p_drop):
 # contain NO vector transposes — Mosaic lowers major-dim transposes to
 # element shuffles that measured 4x slower than the whole attention op.
 _CQ = 128
+
+
+def _pick_cq(tq, tk, h):
+    """Widest q-chunk that divides tq and keeps the phase-split kernels'
+    per-head (cq, tk) f32 temps within Mosaic's scoped-vmem budget (Mosaic
+    sums ALL live temps across the unrolled head loop, so the budget
+    scales with h). Wider chunks amortize the per-program ramp: the fwd
+    kernel measured 0.220 -> 0.152 ms going 128 -> 256 at h=8, tk=256
+    (the measured-safe product h*cq*tk anchoring the bound below).
+    Dropout streams stay chunk-size-independent via _small_dropout_abs."""
+    for c in (256, 128):
+        if c <= tq and tq % c == 0 and h * c * tk <= 8 * 256 * 256:
+            return c
+    return min(tq, _CQ)
 
 
 def _head(x2, hi, dh):
@@ -654,54 +700,45 @@ def _scores_head(q2, k2, hi, dh, scale, bias_ref, hb):
 
 def _fwd_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
                       lse_ref, *, scale, p_drop, h, dh, hb):
+    # Phase-split over heads (all score matmuls, then all softmaxes, then
+    # all pv matmuls): groups the independent per-head matmuls so Mosaic
+    # keeps the MXU busy instead of draining it at every head's softmax.
+    # Measured 0.220 -> 0.152 ms/call with cq=256 (b=64 t=256 h=8 dh=64).
     i, j = pl.program_id(0), pl.program_id(1)
     q2, k2, v2 = q_ref[0], k_ref[0], v_ref[0]   # (cq|tk, h*dh)
-    outs, lses = [], []
-    for hi in range(h):
-        s = _scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
-        m = jnp.max(s, axis=-1, keepdims=True)
-        p = jnp.exp(s - m)
-        l = jnp.sum(p, axis=-1, keepdims=True)
-        lses.append(m + jnp.log(l))        # (cq, 1)
-        if p_drop > 0.0:
-            p = p * _small_dropout(seed_ref, i, j, hi, p.shape, p_drop)
-        o = jax.lax.dot_general(
+    cq, tk = q2.shape[0], k2.shape[0]
+    ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
+          for hi in range(h)]
+    ms = [jnp.max(s, axis=-1, keepdims=True) for s in ss]
+    ps = [jnp.exp(s - m) for s, m in zip(ss, ms)]
+    ls = [jnp.sum(p, axis=-1, keepdims=True) for p in ps]
+    ps = [p * jax.lax.reciprocal(l) for p, l in zip(ps, ls)]
+    if p_drop > 0.0:
+        ps = [p * _small_dropout_abs(seed_ref, i, j, cq, hi, tk, p_drop)
+              for hi, p in enumerate(ps)]
+    outs = [
+        jax.lax.dot_general(
             p.astype(v2.dtype), _head(v2, hi, dh), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) / l                              # (cq, dh)
-        outs.append(o.astype(o_ref.dtype))
+        ).astype(o_ref.dtype)
+        for hi, p in enumerate(ps)
+    ]
     o_ref[0] = jnp.concatenate(outs, axis=-1)       # (cq, h*dh)
-    lse_ref[0] = jnp.concatenate(lses, axis=-1)     # (cq, h)
+    lse_ref[0] = jnp.concatenate(
+        [m + jnp.log(l) for m, l in zip(ms, ls)], axis=-1)  # (cq, h)
 
 
-def _dq_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
-                     lse_ref, delta_ref, dq_ref, *, scale, p_drop, h, dh,
-                     hb):
-    i, j = pl.program_id(0), pl.program_id(1)
-    q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-    lse2, delta2 = lse_ref[0], delta_ref[0]         # (cq, h)
-    dqs = []
-    for hi in range(h):
-        s = _scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
-        p = jnp.exp(s - lse2[:, hi:hi + 1])
-        dp = jax.lax.dot_general(
-            _head(do2, hi, dh), _head(v2, hi, dh), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                   # (cq, tk)
-        if p_drop > 0.0:
-            dp = dp * _small_dropout(seed_ref, i, j, hi, dp.shape, p_drop)
-        ds = p * (dp - delta2[:, hi:hi + 1]) * scale
-        dq = jax.lax.dot_general(
-            ds.astype(k2.dtype), _head(k2, hi, dh), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                   # (cq, dh)
-        dqs.append(dq.astype(dq_ref.dtype))
-    dq_ref[0] = jnp.concatenate(dqs, axis=-1)       # (cq, h*dh)
+def _dqdkv_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                        lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
+                        dk_scr, dv_scr, *, scale, p_drop, nq, h, dh, hb):
+    """Fused backward: one kernel computes dq, dk, dv.
 
-
-def _dkv_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
-                      lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                      *, scale, p_drop, nq, h, dh, hb):
+    Separate dq/dkv kernels each recompute the scores s and the dp
+    matmul — 7 matmuls total, plus double DMA of q/k/v/do/bias. Fusing
+    shares the recompute: 5 matmuls, one operand fetch. Measured
+    0.235 + 0.464 -> 0.33 ms/call (b=64 t=256 h=8 dh=64, dropout on).
+    Phase-split over heads like the forward. dq writes per (i, j) block;
+    dk/dv accumulate in f32 scratch, emitted at the last q-chunk."""
     i, j = pl.program_id(0), pl.program_id(1)
 
     @pl.when(j == 0)
@@ -711,32 +748,35 @@ def _dkv_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
 
     q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
     lse2, delta2 = lse_ref[0], delta_ref[0]         # (cq, h)
+    cq, tk = q2.shape[0], k2.shape[0]
+    ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
+          for hi in range(h)]
+    ps = [jnp.exp(s - lse2[:, hi:hi + 1]) for hi, s in enumerate(ss)]
+    dps = [jax.lax.dot_general(
+        _head(do2, hi, dh), _head(v2, hi, dh), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) for hi in range(h)]
+    if p_drop > 0.0:
+        drops = [_small_dropout_abs(seed_ref, i, j, cq, hi, tk, p_drop)
+                 for hi in range(h)]
+        pds = [p * d for p, d in zip(ps, drops)]
+        dps = [dp * d for dp, d in zip(dps, drops)]
+    else:
+        pds = ps
+    dss = [p * (dp - delta2[:, hi:hi + 1]) * scale
+           for hi, (p, dp) in enumerate(zip(ps, dps))]
+    dqs = [jax.lax.dot_general(
+        ds.astype(k2.dtype), _head(k2, hi, dh), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+        for hi, ds in enumerate(dss)]
+    dq_ref[0] = jnp.concatenate(dqs, axis=-1)       # (cq, h*dh)
     for hi in range(h):
-        s = _scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
-        p = jnp.exp(s - lse2[:, hi:hi + 1])
-        if p_drop > 0.0:
-            drop = _small_dropout(seed_ref, i, j, hi, p.shape, p_drop)
-            pd = p * drop
-        else:
-            pd = p
-        # dv_h += pd^T @ do_h : (tk, cq) x (cq, dh)
+        # dv_h += pd^T @ do_h ; dk_h += ds^T @ q_h   (K = cq, full fill)
         dv_scr[:, hi * dh:(hi + 1) * dh] += jax.lax.dot_general(
-            pd.astype(do2.dtype), _head(do2, hi, dh),
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(
-            _head(do2, hi, dh), _head(v2, hi, dh), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        if p_drop > 0.0:
-            dp = dp * drop
-        ds = p * (dp - delta2[:, hi:hi + 1]) * scale
-        # dk_h += ds^T @ q_h : (tk, cq) x (cq, dh)
+            pds[hi].astype(do2.dtype), _head(do2, hi, dh),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dk_scr[:, hi * dh:(hi + 1) * dh] += jax.lax.dot_general(
-            ds.astype(q2.dtype), _head(q2, hi, dh), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+            dss[hi].astype(q2.dtype), _head(q2, hi, dh),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     @pl.when(j == nq - 1)
     def _emit():
@@ -749,6 +789,279 @@ def _bias_spec_bthd(bias, cq, tk):
     if tq_b == 1:
         return pl.BlockSpec((1, hb, 1, tk), lambda i, j, *_: (i, 0, 0, 0))
     return pl.BlockSpec((1, hb, cq, tk), lambda i, j, *_: (i, 0, j, 0))
+
+
+# ---------------------------------------------------------------------------
+# K-blocked BTHD kernels (512 < tk <= _KB_T_MAX): same 2-D lane-sliced head
+# layout as the single-block kernels — no [b,h,t,dh] transposes around the
+# custom calls (those measured 5.3 ms/step at t=1024) — with the k axis
+# walked in _BK-column grid steps and FlashAttention-2 online softmax.
+# ---------------------------------------------------------------------------
+
+_BK = 256          # k-block width; fixed so fwd/bwd dropout streams align
+_KB_T_MAX = 1024   # dk/dv live whole in f32 scratch: 2 * tk*h*dh*4 bytes
+
+
+def _kb_dropout(seed_ref, i, j, cq, hi, kk, p_drop):
+    """(cq, _BK) keep mask for q-chunk j, k-block kk — same absolute
+    128-row keying as _small_dropout_abs with the (jabs, kk) pair packed
+    into the one mixing slot (nk <= 8 at _KB_T_MAX, jabs <= 4096)."""
+    return _chunked_dropout(seed_ref, i, j, cq, hi, _BK, p_drop,
+                            lambda jabs: jabs * 4096 + kk)
+
+
+def _bias_spec_kb(bias, cq):
+    hb, tq_b = bias.shape[1], bias.shape[2]
+    if tq_b == 1:
+        return pl.BlockSpec((1, hb, 1, _BK),
+                            lambda i, j, kk, *_: (i, 0, 0, kk))
+    return pl.BlockSpec((1, hb, cq, _BK),
+                        lambda i, j, kk, *_: (i, 0, j, kk))
+
+
+def _fwd_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                   m_scr, l_scr, acc_scr, *, scale, p_drop, nk, h, dh, hb):
+    i, j, kk = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q2, k2, v2 = q_ref[0], k_ref[0], v_ref[0]   # (cq, hdh) / (_BK, hdh)
+    cq = q2.shape[0]
+    # Phase-split with ONE batched read-modify-write of each scratch per
+    # program (per-head scratch RMW serialized the loop: measured
+    # 0.78 ms/call before, vs 0.087 analytic, at t=1024).
+    ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
+          for hi in range(h)]                    # (cq, _BK) each
+    m_prev = m_scr[...]                          # (cq, h)
+    l_prev = l_scr[...]
+    m_new = jnp.concatenate(
+        [jnp.maximum(m_prev[:, hi:hi + 1],
+                     jnp.max(ss[hi], axis=-1, keepdims=True))
+         for hi in range(h)], axis=-1)           # (cq, h)
+    ps = [jnp.exp(ss[hi] - m_new[:, hi:hi + 1]) for hi in range(h)]
+    corr = jnp.exp(m_prev - m_new)               # (cq, h)
+    l_scr[...] = l_prev * corr + jnp.concatenate(
+        [jnp.sum(p, axis=-1, keepdims=True) for p in ps], axis=-1)
+    m_scr[...] = m_new
+    if p_drop > 0.0:
+        ps = [p * _kb_dropout(seed_ref, i, j, cq, hi, kk, p_drop)
+              for hi, p in enumerate(ps)]
+    pv = jnp.concatenate(
+        [jax.lax.dot_general(
+            p.astype(v2.dtype), _head(v2, hi, dh), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+         for hi, p in enumerate(ps)], axis=-1)   # (cq, hdh)
+    corr_full = jnp.concatenate(
+        [jnp.broadcast_to(corr[:, hi:hi + 1], (cq, dh)) for hi in range(h)],
+        axis=-1)
+    acc_scr[...] = acc_scr[...] * corr_full + pv
+
+    @pl.when(kk == nk - 1)
+    def _finish():
+        l_all = l_scr[...]
+        recip_full = jnp.concatenate(
+            [jnp.broadcast_to(jax.lax.reciprocal(l_all[:, hi:hi + 1]),
+                              (cq, dh)) for hi in range(h)], axis=-1)
+        o_ref[0] = (acc_scr[...] * recip_full).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(l_all)
+
+
+def _dqdkv_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
+                     lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
+                     dq_scr, dk_scr, dv_scr, *, scale, p_drop, nq, nk, h,
+                     dh, hb):
+    """Fused k-blocked backward: dq accumulates over kk per q-chunk;
+    dk/dv accumulate into FULL-length (tk, h*dh) f32 scratch across the
+    whole (j, kk) walk and are emitted once at the last program."""
+    i, j, kk = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init_dq():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(jnp.logical_and(j == 0, kk == 0))
+    def _init_dkv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    lse2, delta2 = lse_ref[0], delta_ref[0]         # (cq, h)
+    cq = q2.shape[0]
+    ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
+          for hi in range(h)]
+    ps = [jnp.exp(s - lse2[:, hi:hi + 1]) for hi, s in enumerate(ss)]
+    dps = [jax.lax.dot_general(
+        _head(do2, hi, dh), _head(v2, hi, dh), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) for hi in range(h)]
+    if p_drop > 0.0:
+        drops = [_kb_dropout(seed_ref, i, j, cq, hi, kk, p_drop)
+                 for hi in range(h)]
+        pds = [p * d for p, d in zip(ps, drops)]
+        dps = [dp * d for dp, d in zip(dps, drops)]
+    else:
+        pds = ps
+    dss = [p * (dp - delta2[:, hi:hi + 1]) * scale
+           for hi, (p, dp) in enumerate(zip(ps, dps))]
+    # Batched scratch RMW: one load+store per scratch per program instead
+    # of per head (per-head RMW serializes against the matmuls).
+    dq_scr[...] += jnp.concatenate(
+        [jax.lax.dot_general(
+            ds.astype(k2.dtype), _head(k2, hi, dh), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+         for hi, ds in enumerate(dss)], axis=-1)
+    rows = pl.ds(kk * _BK, _BK)
+    dv_scr[rows, :] += jnp.concatenate(
+        [jax.lax.dot_general(
+            pd.astype(do2.dtype), _head(do2, hi, dh),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+         for hi, pd in enumerate(pds)], axis=-1)
+    dk_scr[rows, :] += jnp.concatenate(
+        [jax.lax.dot_general(
+            ds.astype(q2.dtype), _head(q2, hi, dh),
+            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+         for hi, ds in enumerate(dss)], axis=-1)
+
+    @pl.when(kk == nk - 1)
+    def _emit_dq():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+    @pl.when(jnp.logical_and(j == nq - 1, kk == nk - 1))
+    def _emit_dkv():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _use_bthd_kblock(tq, tk, h, dh):
+    # dk/dv live whole in f32 VMEM scratch: 2 * tk * h * dh * 4 bytes must
+    # stay well inside the ~16MB scoped-vmem budget (h*dh=512, tk=1024 ->
+    # 4MB, the measured-safe point; cap at 2x that product).
+    return (
+        (jax.default_backend() == "tpu" or _INTERPRET)
+        and _SMALL_T_MAX < tk <= _KB_T_MAX
+        and tk % _BK == 0
+        and tq >= 8
+        and (tq <= _CQ or tq % _CQ == 0)
+        and tk * h * dh <= 2 * 1024 * 512
+    )
+
+
+def _bthd_kb_fwd(q, k, v, bias, seed, scale, p_drop):
+    b, tq, h, dh = q.shape
+    tk = k.shape[1]
+    cq = _pick_cq(tq, _BK, h)
+    nq, nk = tq // cq, tk // _BK
+    hdh = h * dh
+    in_specs = [
+        pl.BlockSpec((1, cq, hdh), lambda i, j, kk, *_: (i, j, 0)),
+        pl.BlockSpec((1, _BK, hdh), lambda i, j, kk, *_: (i, kk, 0)),
+        pl.BlockSpec((1, _BK, hdh), lambda i, j, kk, *_: (i, kk, 0)),
+    ]
+    args = [q.reshape(b, tq, hdh), k.reshape(b, tk, hdh),
+            v.reshape(b, tk, hdh)]
+    hb = 1 if bias is None else bias.shape[1]
+    if bias is not None:
+        in_specs.append(_bias_spec_kb(bias, cq))
+        args.append(bias)
+        kernel = functools.partial(_fwd_kb_kernel, scale=scale,
+                                   p_drop=p_drop, nk=nk, h=h, dh=dh, hb=hb)
+    else:
+        kernel = functools.partial(
+            lambda sr, qr, kr, vr, orf, lr, ms, ls, ac, **kw:
+                _fwd_kb_kernel(sr, qr, kr, vr, None, orf, lr, ms, ls, ac,
+                               **kw),
+            scale=scale, p_drop=p_drop, nk=nk, h=h, dh=dh, hb=hb,
+        )
+    out2, lse2 = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, nq, nk),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, cq, hdh), lambda i, j, kk, *_: (i, j, 0)),
+                pl.BlockSpec((1, cq, h), lambda i, j, kk, *_: (i, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((cq, h), jnp.float32),
+                pltpu.VMEM((cq, h), jnp.float32),
+                pltpu.VMEM((cq, hdh), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, tq, hdh), q.dtype),
+            jax.ShapeDtypeStruct((b, tq, h), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(_seed_arr(seed), *args)
+    return out2.reshape(b, tq, h, dh), lse2[..., None]
+
+
+def _bthd_kb_bwd(q, k, v, bias, seed, out, lse, g, scale, p_drop):
+    b, tq, h, dh = q.shape
+    tk = k.shape[1]
+    cq = min(_pick_cq(tq, _BK, h), _CQ)
+    nq, nk = tq // cq, tk // _BK
+    hdh = h * dh
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    base_specs = [
+        pl.BlockSpec((1, cq, hdh), lambda i, j, kk, *_: (i, j, 0)),
+        pl.BlockSpec((1, _BK, hdh), lambda i, j, kk, *_: (i, kk, 0)),
+        pl.BlockSpec((1, _BK, hdh), lambda i, j, kk, *_: (i, kk, 0)),
+    ]
+    base_args = [q.reshape(b, tq, hdh), k.reshape(b, tk, hdh),
+                 v.reshape(b, tk, hdh)]
+    hb = 1 if bias is None else bias.shape[1]
+    if bias is not None:
+        base_specs.append(_bias_spec_kb(bias, cq))
+        base_args.append(bias)
+    tail_specs = [
+        pl.BlockSpec((1, cq, hdh), lambda i, j, kk, *_: (i, j, 0)),
+        pl.BlockSpec((1, cq, h), lambda i, j, kk, *_: (i, j, 0)),
+        pl.BlockSpec((1, cq, h), lambda i, j, kk, *_: (i, j, 0)),
+    ]
+    tail_args = [g.reshape(b, tq, hdh), lse[..., 0], delta[..., 0]]
+    if bias is not None:
+        kernel = functools.partial(_dqdkv_kb_kernel, scale=scale,
+                                   p_drop=p_drop, nq=nq, nk=nk, h=h, dh=dh,
+                                   hb=hb)
+    else:
+        kernel = functools.partial(
+            lambda sr, qr, kr, vr, dor, lr, der, dqr, dkr, dvr, dqs, dks,
+            dvs, **kw: _dqdkv_kb_kernel(sr, qr, kr, vr, None, dor, lr, der,
+                                        dqr, dkr, dvr, dqs, dks, dvs, **kw),
+            scale=scale, p_drop=p_drop, nq=nq, nk=nk, h=h, dh=dh, hb=hb,
+        )
+    dq2, dk2, dv2 = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, nq, nk),
+            in_specs=base_specs + tail_specs,
+            out_specs=[
+                pl.BlockSpec((1, cq, hdh), lambda i, j, kk, *_: (i, j, 0)),
+                pl.BlockSpec((1, tk, hdh), lambda i, j, kk, *_: (i, 0, 0)),
+                pl.BlockSpec((1, tk, hdh), lambda i, j, kk, *_: (i, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((cq, hdh), jnp.float32),
+                pltpu.VMEM((tk, hdh), jnp.float32),
+                pltpu.VMEM((tk, hdh), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, tq, hdh), q.dtype),
+            jax.ShapeDtypeStruct((b, tk, hdh), k.dtype),
+            jax.ShapeDtypeStruct((b, tk, hdh), v.dtype),
+        ],
+        interpret=_INTERPRET,
+    )(_seed_arr(seed), *base_args, *tail_args)
+    return (dq2.reshape(b, tq, h, dh), dk2.reshape(b, tk, h, dh),
+            dv2.reshape(b, tk, h, dh))
 
 
 def _reference_attention_bthd(q, k, v, bias, scale, p_drop=0.0, seed=None):
@@ -775,8 +1088,11 @@ def flash_attention_bthd_fwd(q, k, v, bias=None, seed=None, scale=None,
     if scale is None:
         scale = 1.0 / math.sqrt(dh)
     if not _use_bthd_small(tq, tk):
+        if _use_bthd_kblock(tq, tk, h, dh):
+            return _bthd_kb_fwd(q, k, v, bias, seed, scale, p_drop)
         if (jax.default_backend() == "tpu" or _INTERPRET) and tk > _SMALL_T_MAX:
-            # long context: one transpose pair into the K-blocked kernels
+            # very long context: one transpose pair into the head-batched
+            # K-blocked kernels (dk/dv won't fit VMEM scratch as one piece)
             out, lse = flash_attention_fwd(
                 jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                 jnp.swapaxes(v, 1, 2), bias, seed, scale, p_drop)
@@ -785,7 +1101,7 @@ def flash_attention_bthd_fwd(q, k, v, bias=None, seed=None, scale=None,
                                         seed if p_drop > 0.0 else None)
         return out, jnp.zeros((b, tq, h, 1), jnp.float32)
 
-    cq = min(tq, _CQ)
+    cq = _pick_cq(tq, tk, h)
     nq = tq // cq
     hdh = h * dh
     in_specs = [
@@ -836,6 +1152,9 @@ def flash_attention_bthd_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
     if scale is None:
         scale = 1.0 / math.sqrt(dh)
     if not _use_bthd_small(tq, tk):
+        if _use_bthd_kblock(tq, tk, h, dh):
+            return _bthd_kb_bwd(q, k, v, bias, seed, out, lse, g, scale,
+                                p_drop)
         if (jax.default_backend() == "tpu" or _INTERPRET) and tk > _SMALL_T_MAX:
             dq, dk, dv = flash_attention_bwd(
                 jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
@@ -853,7 +1172,10 @@ def flash_attention_bthd_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
         _, vjp = jax.vjp(f, q, k, v)
         return vjp(g)
 
-    cq = min(tq, _CQ)
+    # The fused kernel keeps four (cq, tk) f32 temps per head live; halve
+    # the chunk relative to the forward so the per-head phase temps fit
+    # Mosaic's scoped-vmem budget. Dropout streams are chunk-independent.
+    cq = min(_pick_cq(tq, tk, h), _CQ)
     nq = tq // cq
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)         # [b, tq, h, 1]
@@ -877,44 +1199,24 @@ def flash_attention_bthd_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
 
     hb = 1 if bias is None else bias.shape[1]
     if bias is not None:
-        dq_kernel = functools.partial(_dq_small_kernel, scale=scale,
-                                      p_drop=p_drop, h=h, dh=dh, hb=hb)
-        dkv_kernel = functools.partial(_dkv_small_kernel, scale=scale,
-                                       p_drop=p_drop, nq=nq, h=h, dh=dh,
-                                       hb=hb)
+        kernel = functools.partial(_dqdkv_small_kernel, scale=scale,
+                                   p_drop=p_drop, nq=nq, h=h, dh=dh, hb=hb)
     else:
-        dq_kernel = functools.partial(
-            lambda sr, qr, kr, vr, dor, lr, der, dqr, **kw:
-                _dq_small_kernel(sr, qr, kr, vr, None, dor, lr, der, dqr,
-                                 **kw),
-            scale=scale, p_drop=p_drop, h=h, dh=dh, hb=hb,
-        )
-        dkv_kernel = functools.partial(
-            lambda sr, qr, kr, vr, dor, lr, der, dkr, dvr, dks, dvs, **kw:
-                _dkv_small_kernel(sr, qr, kr, vr, None, dor, lr, der,
-                                  dkr, dvr, dks, dvs, **kw),
+        kernel = functools.partial(
+            lambda sr, qr, kr, vr, dor, lr, der, dqr, dkr, dvr, dks, dvs,
+            **kw: _dqdkv_small_kernel(sr, qr, kr, vr, None, dor, lr, der,
+                                      dqr, dkr, dvr, dks, dvs, **kw),
             scale=scale, p_drop=p_drop, nq=nq, h=h, dh=dh, hb=hb,
         )
 
-    dq2 = pl.pallas_call(
-        dq_kernel,
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(b, nq),
-            in_specs=base_specs + tail_specs,
-            out_specs=pl.BlockSpec((1, cq, hdh), lambda i, j, *_: (i, j, 0)),
-        ),
-        out_shape=jax.ShapeDtypeStruct((b, tq, hdh), q.dtype),
-        interpret=_INTERPRET,
-    )(_seed_arr(seed), *base_args, *tail_args)
-
-    dk2, dv2 = pl.pallas_call(
-        dkv_kernel,
+    dq2, dk2, dv2 = pl.pallas_call(
+        kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, nq),
             in_specs=base_specs + tail_specs,
             out_specs=[
+                pl.BlockSpec((1, cq, hdh), lambda i, j, *_: (i, j, 0)),
                 pl.BlockSpec((1, tk, hdh), lambda i, j, *_: (i, 0, 0)),
                 pl.BlockSpec((1, tk, hdh), lambda i, j, *_: (i, 0, 0)),
             ],
@@ -924,6 +1226,7 @@ def flash_attention_bthd_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
             ],
         ),
         out_shape=[
+            jax.ShapeDtypeStruct((b, tq, hdh), q.dtype),
             jax.ShapeDtypeStruct((b, tk, hdh), k.dtype),
             jax.ShapeDtypeStruct((b, tk, hdh), v.dtype),
         ],

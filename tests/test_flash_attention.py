@@ -241,3 +241,40 @@ def test_bthd_non_cq_multiple_tq_falls_back_dense():
     ref = fa._reference_attention_bthd(q, k, v, None, 1.0 / np.sqrt(64))
     assert np.isfinite(np.asarray(out)).all()
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+# --- K-blocked BTHD path (512 < tk <= _KB_T_MAX, no transposes) ---
+
+
+def test_bthd_kblock_forward_matches_reference():
+    b, tq, tk, h, dh = 1, 16, 768, 2, 32
+    q = jnp.asarray(_rand((b, tq, h, dh), 3) * 0.3)
+    k = jnp.asarray(_rand((b, tk, h, dh), 4) * 0.3)
+    v = jnp.asarray(_rand((b, tk, h, dh), 5) * 0.3)
+    assert fa._use_bthd_kblock(tq, tk, h, dh)
+    out, lse = fa.flash_attention_bthd_fwd(q, k, v)
+    ref = fa._reference_attention_bthd(q, k, v, None, 1.0 / np.sqrt(dh))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    assert np.isfinite(np.asarray(lse)).all()
+
+
+def test_bthd_kblock_backward_matches_reference():
+    b, tq, tk, h, dh = 1, 16, 768, 2, 32
+    q = jnp.asarray(_rand((b, tq, h, dh), 6) * 0.3)
+    k = jnp.asarray(_rand((b, tk, h, dh), 7) * 0.3)
+    v = jnp.asarray(_rand((b, tk, h, dh), 8) * 0.3)
+    g = jnp.asarray(_rand((b, tq, h, dh), 9) * 0.3)
+    bias = _pad_bias(b, tk, 21)
+    out, lse = fa.flash_attention_bthd_fwd(q, k, v, bias)
+    dq, dk, dv = fa.flash_attention_bthd_bwd(q, k, v, bias, None, out, lse,
+                                             g)
+
+    def f(q, k, v):
+        return jnp.sum(
+            fa._reference_attention_bthd(q, k, v, bias, 1.0 / np.sqrt(dh))
+            * g)
+
+    rdq, rdk, rdv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rdq), atol=3e-5)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rdk), atol=3e-5)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rdv), atol=3e-5)

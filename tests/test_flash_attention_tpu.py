@@ -1,0 +1,119 @@
+"""TPU-only attention kernel checks (skipped on CPU backends).
+
+These pin the invariants the Pallas interpreter cannot reach:
+1. the forward (cq up to 256) and fused backward (cq=128) kernels
+   regenerate bit-identical dropout masks from the absolute 128-row-block
+   keying (incl. the u32->u16 bitcast shape convention), verified by
+   comparing the kernel path against a dense reference fed the kernels'
+   OWN masks (dumped via the same helpers);
+2. hardware numerical parity of the single-block and K-blocked BTHD
+   kernels (fwd + grads) against the dense composition.
+
+The driver runs the suite on TPU each round; on CPU these skip.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import flash_attention as fa
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu", reason="needs a real TPU backend")
+
+
+def _dump_masks(b, tq, tk, h, pd, seed):
+    """The kernels' dropout masks, reproduced with the kernels' own
+    helpers/keys: (b, tq, h, tk) f32 scaled keep masks."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    kblock = tk > fa._SMALL_T_MAX
+    cq = 128 if tq >= 128 else tq
+    nq = tq // cq
+
+    def kern(seed_ref, x_ref, o_ref):
+        i, j = pl.program_id(0), pl.program_id(1)
+        for hi in range(h):
+            if kblock:
+                parts = [fa._kb_dropout(seed_ref, i, j, cq, hi, kk, pd)
+                         for kk in range(tk // fa._BK)]
+                m = jnp.concatenate(parts, axis=-1)
+            else:
+                m = fa._small_dropout_abs(seed_ref, i, j, cq, hi, tk, pd)
+            o_ref[0, :, hi * tk:(hi + 1) * tk] = m.astype(jnp.float32)
+
+    out = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1, grid=(b, nq),
+            in_specs=[pl.BlockSpec((1, 8, 128), lambda i, j, *_: (0, 0, 0))],
+            out_specs=[pl.BlockSpec((1, cq, h * tk),
+                                    lambda i, j, *_: (i, j, 0))]),
+        out_shape=[jax.ShapeDtypeStruct((b, tq, h * tk), jnp.float32)])(
+            jnp.asarray([seed], jnp.uint32),
+            jnp.zeros((1, 8, 128), jnp.float32))[0]
+    return np.asarray(out).reshape(b, tq, h, tk)
+
+
+@pytest.mark.parametrize("b,tq,tk,h,dh,pd", [
+    (2, 256, 256, 3, 64, 0.3),     # single-block, fwd cq=256 vs bwd 128
+    (1, 128, 768, 2, 64, 0.3),     # K-blocked
+])
+def test_dropout_fwd_bwd_mask_consistency(b, tq, tk, h, dh, pd):
+    seedv = 11
+    r = np.random.RandomState(7)
+    masks = _dump_masks(b, tq, tk, h, pd, seedv)
+    q = jnp.asarray(r.normal(0, 1, (b, tq, h, dh))).astype(jnp.bfloat16)
+    k = jnp.asarray(r.normal(0, 1, (b, tk, h, dh))).astype(jnp.bfloat16)
+    v = jnp.asarray(r.normal(0, 1, (b, tk, h, dh))).astype(jnp.bfloat16)
+    w = jnp.asarray(r.normal(0, 1, (b, tq, h, dh)).astype(np.float32))
+    mask_bhqk = jnp.asarray(masks).transpose(0, 2, 1, 3)
+
+    def fk(q, k, v):
+        o, _ = fa.flash_attention_bthd_with_lse(
+            q, k, v, None, jnp.uint32(seedv), None, pd)
+        return jnp.sum(o.astype(jnp.float32) * w)
+
+    def fr(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) / np.sqrt(dh)
+        p = jax.nn.softmax(s, -1) * mask_bhqk
+        o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+        return jnp.sum(o.astype(jnp.float32) * w)
+
+    l1, g1 = jax.value_and_grad(fk, (0, 1, 2))(q, k, v)
+    l2, g2 = jax.value_and_grad(fr, (0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_, np.float32), atol=0.05)
+
+
+@pytest.mark.parametrize("b,tq,tk,h,dh", [
+    (2, 256, 256, 8, 64),
+    (1, 256, 1024, 4, 64),
+    (1, 1024, 768, 4, 64),
+])
+def test_hw_parity_vs_dense(b, tq, tk, h, dh):
+    r = np.random.RandomState(3)
+    q = jnp.asarray(r.normal(0, 1, (b, tq, h, dh))).astype(jnp.bfloat16)
+    k = jnp.asarray(r.normal(0, 1, (b, tk, h, dh))).astype(jnp.bfloat16)
+    v = jnp.asarray(r.normal(0, 1, (b, tk, h, dh))).astype(jnp.bfloat16)
+    bias = jnp.asarray(r.normal(0, 1, (b, 1, tq, tk)).astype(np.float32))
+    w = jnp.asarray(r.normal(0, 1, (b, tq, h, dh)).astype(np.float32))
+
+    def f(q, k, v):
+        o, _ = fa.flash_attention_bthd_with_lse(q, k, v, bias)
+        return jnp.sum(o.astype(jnp.float32) * w)
+
+    def ref(q, k, v):
+        o = fa._reference_attention_bthd(q, k, v, bias, 1.0 / np.sqrt(dh))
+        return jnp.sum(o.astype(jnp.float32) * w)
+
+    g1 = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b_, np.float32), atol=0.05)
