@@ -76,7 +76,7 @@ class DataParallel(Layer):
         # the placement (replicated op on replicated operands). Layers
         # that build parameters lazily (FC on first forward) are re-placed
         # after the first call — see forward().
-        self._placed = False
+        self._placed_n_params = -1
         self._replicate_params()
 
     def _replicate_params(self):
@@ -114,11 +114,15 @@ class DataParallel(Layer):
             for x in inputs
         ]
         out = self._layers(*sharded, **kwargs)
-        if not self._placed:
-            # lazily-built parameters (FC et al. materialize weights on
-            # their first call) now exist — pin them replicated
+        # Lazily-built parameters (FC et al. materialize weights on their
+        # first call) must be pinned replicated. Sublayers may keep lazy-
+        # building on LATER calls (shape-dependent builds), so re-pin
+        # whenever the parameter count grows — device_put on an already-
+        # replicated array is cheap.
+        n_params = len(self._layers.parameters())
+        if n_params != self._placed_n_params:
             self._replicate_params()
-            self._placed = True
+            self._placed_n_params = n_params
         return out
 
     def scale_loss(self, loss: VarBase) -> VarBase:

@@ -42,6 +42,14 @@ def _x(ins, slot, i=0):
         "under a strategy expert axis (parallel/moe.py)",
 )
 def _switch_moe(ins, attrs):
+    """Capacity caveat: expert capacity is ``cap_factor * n_local / e``
+    where n_local is the PER-RANK token count under a data axis. Global
+    capacity matches the dense path (capacity * ranks == cap_factor*n/e),
+    but truncation applies per rank — so a 1-device and an n-device run
+    of the same program are bit-comparable only while no expert
+    overflows its per-rank capacity (skewed routing truncates earlier
+    distributed). Raise ``capacity_factor`` if dropped-token parity
+    matters (see tests/test_moe_ir.py)."""
     x = _x(ins, "X")
     gate_w = _x(ins, "GateW")
     w1, b1 = _x(ins, "W1"), _x(ins, "B1")

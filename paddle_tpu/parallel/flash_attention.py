@@ -728,6 +728,29 @@ def _fwd_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
         [m + jnp.log(l) for m, l in zip(ms, ls)], axis=-1)  # (cq, h)
 
 
+def _bwd_head_grads(q2, k2, v2, do2, lse2, delta2, bias_ref, scale, p_drop,
+                    h, dh, hb, drop_fn):
+    """Shared per-head backward phase: recompute scores, p = exp(s - lse),
+    dp = do @ v^T, then (pds, dss) with the dropout mask applied
+    identically to p and dp while dss uses the UNdropped p — the invariant
+    both the single-block and K-blocked fused backwards must hold."""
+    ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
+          for hi in range(h)]
+    ps = [jnp.exp(s - lse2[:, hi:hi + 1]) for hi, s in enumerate(ss)]
+    dps = [jax.lax.dot_general(
+        _head(do2, hi, dh), _head(v2, hi, dh), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) for hi in range(h)]
+    if p_drop > 0.0:
+        drops = [drop_fn(hi) for hi in range(h)]
+        pds = [p * d for p, d in zip(ps, drops)]
+        dps = [dp * d for dp, d in zip(dps, drops)]
+    else:
+        pds = ps
+    dss = [p * (dp - delta2[:, hi:hi + 1]) * scale
+           for hi, (p, dp) in enumerate(zip(ps, dps))]
+    return pds, dss
+
+
 def _dqdkv_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
                         lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
                         dk_scr, dv_scr, *, scale, p_drop, nq, h, dh, hb):
@@ -749,21 +772,9 @@ def _dqdkv_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
     q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
     lse2, delta2 = lse_ref[0], delta_ref[0]         # (cq, h)
     cq, tk = q2.shape[0], k2.shape[0]
-    ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
-          for hi in range(h)]
-    ps = [jnp.exp(s - lse2[:, hi:hi + 1]) for hi, s in enumerate(ss)]
-    dps = [jax.lax.dot_general(
-        _head(do2, hi, dh), _head(v2, hi, dh), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) for hi in range(h)]
-    if p_drop > 0.0:
-        drops = [_small_dropout_abs(seed_ref, i, j, cq, hi, tk, p_drop)
-                 for hi in range(h)]
-        pds = [p * d for p, d in zip(ps, drops)]
-        dps = [dp * d for dp, d in zip(dps, drops)]
-    else:
-        pds = ps
-    dss = [p * (dp - delta2[:, hi:hi + 1]) * scale
-           for hi, (p, dp) in enumerate(zip(ps, dps))]
+    pds, dss = _bwd_head_grads(
+        q2, k2, v2, do2, lse2, delta2, bias_ref, scale, p_drop, h, dh, hb,
+        lambda hi: _small_dropout_abs(seed_ref, i, j, cq, hi, tk, p_drop))
     dqs = [jax.lax.dot_general(
         ds.astype(k2.dtype), _head(k2, hi, dh), (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(dq_ref.dtype)
@@ -891,21 +902,9 @@ def _dqdkv_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
     q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
     lse2, delta2 = lse_ref[0], delta_ref[0]         # (cq, h)
     cq = q2.shape[0]
-    ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
-          for hi in range(h)]
-    ps = [jnp.exp(s - lse2[:, hi:hi + 1]) for hi, s in enumerate(ss)]
-    dps = [jax.lax.dot_general(
-        _head(do2, hi, dh), _head(v2, hi, dh), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) for hi in range(h)]
-    if p_drop > 0.0:
-        drops = [_kb_dropout(seed_ref, i, j, cq, hi, kk, p_drop)
-                 for hi in range(h)]
-        pds = [p * d for p, d in zip(ps, drops)]
-        dps = [dp * d for dp, d in zip(dps, drops)]
-    else:
-        pds = ps
-    dss = [p * (dp - delta2[:, hi:hi + 1]) * scale
-           for hi, (p, dp) in enumerate(zip(ps, dps))]
+    pds, dss = _bwd_head_grads(
+        q2, k2, v2, do2, lse2, delta2, bias_ref, scale, p_drop, h, dh, hb,
+        lambda hi: _kb_dropout(seed_ref, i, j, cq, hi, kk, p_drop))
     # Batched scratch RMW: one load+store per scratch per program instead
     # of per head (per-head RMW serializes against the matmuls).
     dq_scr[...] += jnp.concatenate(
@@ -1242,7 +1241,12 @@ def flash_attention_bthd_with_lse(q, k, v, bias=None, seed=None,
                                   p_drop: float = 0.0):
     """(out, lse) in BTHD with a custom vjp over the single-block kernels
     (pallas_call has no JVP rule); the paired sdpa grad op uses the _bwd
-    entry directly with the saved stats."""
+    entry directly with the saved stats.
+
+    ``bias`` is mask plumbing, NOT a trainable input: on the Pallas paths
+    its cotangent is ZEROS (a true dbias would materialize a tq x tk
+    gradient per head). Pass a learnable additive bias only through the
+    dense composition (small shapes), which computes the real dbias."""
     return flash_attention_bthd_fwd(q, k, v, bias, seed, scale, p_drop)
 
 
