@@ -1,0 +1,396 @@
+"""Detection layer API (reference: python/paddle/fluid/layers/detection.py).
+
+Signatures mirror the reference with one systematic change: ground-truth
+inputs that were LoD tensors ([Ng, 4] with per-image offsets) are dense
+padded tensors ([N, G, 4] with zero-area rows as padding, labels
+alongside) — the SURVEY.md section 5 design. Outputs that were LoD lists
+are fixed-capacity tensors plus counts/weights.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.layers import nn as _nn
+
+__all__ = [
+    "iou_similarity", "box_coder", "prior_box", "density_prior_box",
+    "anchor_generator", "bipartite_match", "target_assign", "ssd_loss",
+    "detection_output", "multi_box_head", "yolov3_loss", "detection_map",
+    "rpn_target_assign", "generate_proposals", "generate_proposal_labels",
+    "distribute_fpn_proposals", "collect_fpn_proposals",
+    "box_decoder_and_assign", "box_clip",
+]
+
+
+def _op(op_type, inputs, attrs=None, out_slots=("Out",), dtypes=None,
+        name=None, stop_gradient=False):
+    helper = LayerHelper(op_type, name=name)
+    first = next(v for v in inputs.values() if v is not None)
+    base = first[0] if isinstance(first, (list, tuple)) else first
+    outs = {}
+    for i, s in enumerate(out_slots):
+        dt = (dtypes[i] if dtypes else None) or base.dtype
+        outs[s] = helper.create_variable_for_type_inference(
+            dtype=dt, stop_gradient=stop_gradient)
+    helper.append_op(op_type,
+                     inputs={k: v for k, v in inputs.items()
+                             if v is not None},
+                     outputs=outs, attrs=attrs or {})
+    vals = [outs[s] for s in out_slots]
+    return vals[0] if len(vals) == 1 else tuple(vals)
+
+
+def iou_similarity(x, y, name=None):
+    """Pairwise IoU (reference: detection.py:328)."""
+    return _op("iou_similarity", {"X": x, "Y": y}, name=name,
+               stop_gradient=True)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    """Encode/decode boxes against priors (reference: detection.py:365)."""
+    return _op("box_coder",
+               {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+                "TargetBox": target_box},
+               {"code_type": code_type, "box_normalized": box_normalized,
+                "axis": axis},
+               out_slots=("OutputBox",), name=name)
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (reference: detection.py:2267)."""
+    return _op("box_clip", {"Input": input, "ImInfo": im_info}, name=name,
+               out_slots=("Output",))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    """SSD prior boxes per feature-map cell (reference: detection.py:1247).
+    Outputs Boxes/Variances [H, W, P, 4]."""
+    attrs = {
+        "min_sizes": list(min_sizes),
+        "max_sizes": list(max_sizes or []),
+        "aspect_ratios": list(aspect_ratios),
+        "variances": list(variance),
+        "flip": flip, "clip": clip,
+        "step_w": steps[0], "step_h": steps[1], "offset": offset,
+        "min_max_aspect_ratios_order": min_max_aspect_ratios_order,
+    }
+    return _op("prior_box", {"Input": input, "Image": image}, attrs,
+               out_slots=("Boxes", "Variances"), name=name,
+               stop_gradient=True)
+
+
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """Density prior boxes (reference: detection.py:1369)."""
+    attrs = {
+        "densities": list(densities or []),
+        "fixed_sizes": list(fixed_sizes or []),
+        "fixed_ratios": list(fixed_ratios or []),
+        "variances": list(variance), "clip": clip,
+        "step_w": steps[0], "step_h": steps[1], "offset": offset,
+        "flatten_to_2d": flatten_to_2d,
+    }
+    return _op("density_prior_box", {"Input": input, "Image": image}, attrs,
+               out_slots=("Boxes", "Variances"), name=name,
+               stop_gradient=True)
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    """RPN anchors per feature-map cell (reference: detection.py:1753)."""
+    attrs = {
+        "anchor_sizes": list(anchor_sizes or [64.0, 128.0, 256.0, 512.0]),
+        "aspect_ratios": list(aspect_ratios or [0.5, 1.0, 2.0]),
+        "variances": list(variance),
+        "stride": list(stride or [16.0, 16.0]),
+        "offset": offset,
+    }
+    return _op("anchor_generator", {"Input": input}, attrs,
+               out_slots=("Anchors", "Variances"), name=name,
+               stop_gradient=True)
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching (reference: detection.py:830).
+    ``dist_matrix`` [G, P] or batched [N, G, P]."""
+    return _op("bipartite_match", {"DistMat": dist_matrix},
+               {"match_type": match_type or "bipartite",
+                "dist_threshold": dist_threshold or 0.5},
+               out_slots=("ColToRowMatchIndices", "ColToRowMatchDist"),
+               dtypes=("int32", None), name=name, stop_gradient=True)
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """Assign targets by match indices (reference: detection.py:916).
+    ``input`` [N, G, K] dense per-image entities."""
+    return _op("target_assign",
+               {"X": input, "MatchIndices": matched_indices,
+                "NegIndices": negative_indices},
+               {"mismatch_value": mismatch_value or 0.0},
+               out_slots=("Out", "OutWeight"), name=name,
+               stop_gradient=True)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None,
+             name=None):
+    """SSD multibox loss -> [N, 1] (reference: detection.py:1013; the
+    bipartite-match/mining/target-assign pipeline runs as one fused dense
+    op, see ops/detection_ops.py ssd_loss). ``gt_box`` [N, G, 4] padded
+    dense, ``gt_label`` [N, G]."""
+    if mining_type != "max_negative":
+        raise ValueError("Only mining_type == 'max_negative' is supported")
+    return _op("ssd_loss",
+               {"Location": location, "Confidence": confidence,
+                "GtBox": gt_box, "GtLabel": gt_label,
+                "PriorBox": prior_box, "PriorBoxVar": prior_box_var},
+               {"background_label": background_label,
+                "overlap_threshold": overlap_threshold,
+                "neg_pos_ratio": neg_pos_ratio, "neg_overlap": neg_overlap,
+                "loc_loss_weight": loc_loss_weight,
+                "conf_loss_weight": conf_loss_weight,
+                "match_type": match_type, "normalize": normalize},
+               out_slots=("Loss",), name=name)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     name=None):
+    """Decode + multiclass NMS (reference: detection.py:213). ``loc``
+    [N, P, 4], ``scores`` [N, P, C] (post-softmax). Output
+    [N, keep_top_k, 6] rows (label, score, x1, y1, x2, y2), label -1
+    padding — the dense analog of the reference's LoD output."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    scores_t = _nn.transpose(scores, [0, 2, 1])     # [N, C, P]
+    return _nn.multiclass_nms(
+        decoded, scores_t, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, background_label=background_label,
+        name=name)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head over multiple feature maps (reference:
+    detection.py:1497): per-map loc/conf convs + prior boxes,
+    concatenated. Returns (mbox_locs [N, P, 4], mbox_confs [N, P, C],
+    boxes [P, 4], variances [P, 4])."""
+    if isinstance(inputs, (list, tuple)) is False:
+        inputs = [inputs]
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule (detection.py:1657)
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_maps - 2.0)) if n_maps > 2 \
+            else 100
+        min_sizes.append(base_size * 0.1)
+        max_sizes.append(base_size * 0.2)
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = min_sizes[:n_maps]
+        max_sizes = max_sizes[:n_maps]
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, x in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        mins = mins if isinstance(mins, (list, tuple)) else [mins]
+        maxs = (maxs if isinstance(maxs, (list, tuple)) else [maxs]) \
+            if maxs is not None else None
+        ars = aspect_ratios[i]
+        ars = ars if isinstance(ars, (list, tuple)) else [ars]
+        step_pair = (steps[i] if steps else
+                     ((step_w[i] if step_w else 0.0),
+                      (step_h[i] if step_h else 0.0)))
+        if not isinstance(step_pair, (list, tuple)):
+            step_pair = (step_pair, step_pair)
+        box, var = prior_box(
+            x, image, mins, maxs, ars, variance, flip, clip,
+            step_pair, offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        # priors per cell: mirror the prior_box op's aspect-ratio dedup
+        # (1.0 implicit; flip adds the reciprocal of each non-1 ratio)
+        uniq = [1.0]
+        for a in ars:
+            if not any(abs(a - u) < 1e-6 for u in uniq):
+                uniq.append(a)
+                if flip:
+                    uniq.append(1.0 / a)
+        n_priors = len(mins) * len(uniq) + (len(maxs) if maxs else 0)
+        loc = _nn.conv2d(x, n_priors * 4, kernel_size, stride=stride,
+                         padding=pad)
+        conf = _nn.conv2d(x, n_priors * num_classes, kernel_size,
+                          stride=stride, padding=pad)
+        # [N, P_i*4, H, W] -> [N, H, W, P_i*4] -> [N, -1, 4]
+        loc = _nn.transpose(loc, [0, 2, 3, 1])
+        conf = _nn.transpose(conf, [0, 2, 3, 1])
+        locs.append(_nn.reshape(loc, [0, -1, 4]))
+        confs.append(_nn.reshape(conf, [0, -1, num_classes]))
+        boxes_l.append(_nn.reshape(box, [-1, 4]))
+        vars_l.append(_nn.reshape(var, [-1, 4]))
+    mbox_locs = _nn.concat(locs, axis=1)
+    mbox_confs = _nn.concat(confs, axis=1)
+    boxes = _nn.concat(boxes_l, axis=0)
+    variances = _nn.concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """YOLOv3 loss -> [N] (reference: detection.py:536)."""
+    loss, _, _ = _op(
+        "yolov3_loss",
+        {"X": x, "GTBox": gt_box, "GTLabel": gt_label, "GTScore": gt_score},
+        {"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+         "class_num": class_num, "ignore_thresh": ignore_thresh,
+         "downsample_ratio": downsample_ratio,
+         "use_label_smooth": use_label_smooth},
+        out_slots=("Loss", "ObjectnessMask", "GTMatchMask"),
+        dtypes=(None, None, "int32"), name=name)
+    return loss
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral", name=None, **_compat):
+    """Batch mAP scalar (reference: detection.py:738)."""
+    return _op("detection_map", {"DetectRes": detect_res, "Label": label},
+               {"class_num": class_num,
+                "background_label": background_label,
+                "overlap_threshold": overlap_threshold,
+                "evaluate_difficult": evaluate_difficult,
+                "ap_type": ap_version},
+               out_slots=("MAP",), dtypes=("float32",), name=name,
+               stop_gradient=True)
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True, name=None):
+    """RPN anchor labelling (reference: detection.py:61). Dense outputs:
+    (score_label [N, M], score_weight [N, M], bbox_target [N, M, 4],
+    bbox_weight [N, M, 4]) — losses contract with the weights instead of
+    gathering LoD index lists."""
+    return _op("rpn_target_assign",
+               {"Anchor": anchor_box, "GtBoxes": gt_boxes,
+                "ImInfo": im_info, "IsCrowd": is_crowd},
+               {"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                "rpn_straddle_thresh": rpn_straddle_thresh,
+                "rpn_fg_fraction": rpn_fg_fraction,
+                "rpn_positive_overlap": rpn_positive_overlap,
+                "rpn_negative_overlap": rpn_negative_overlap,
+                "use_random": use_random},
+               out_slots=("ScoreLabel", "ScoreWeight", "BboxTarget",
+                          "BboxWeight"),
+               name=name, stop_gradient=True)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """RPN proposals (reference: detection.py:2162). Returns
+    (rpn_rois [N, post_nms_top_n, 4], rpn_roi_probs [N, post_nms_top_n, 1],
+    rois_num [N])."""
+    return _op("generate_proposals",
+               {"Scores": scores, "BboxDeltas": bbox_deltas,
+                "ImInfo": im_info, "Anchors": anchors,
+                "Variances": variances},
+               {"pre_nms_topN": pre_nms_top_n,
+                "post_nms_topN": post_nms_top_n,
+                "nms_thresh": nms_thresh, "min_size": min_size, "eta": eta},
+               out_slots=("RpnRois", "RpnRoiProbs", "RpnRoisNum"),
+               dtypes=(None, None, "int32"), name=name, stop_gradient=True)
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False,
+                             name=None):
+    """Second-stage RoI sampling (reference: detection.py:1907). Returns
+    (rois [N, B, 4], labels_int32 [N, B], bbox_targets
+    [N, B, 4*class_nums], bbox_inside_weights, bbox_outside_weights)."""
+    return _op("generate_proposal_labels",
+               {"RpnRois": rpn_rois, "GtClasses": gt_classes,
+                "GtBoxes": gt_boxes, "ImInfo": im_info,
+                "IsCrowd": is_crowd},
+               {"batch_size_per_im": batch_size_per_im,
+                "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+                "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+                "class_nums": class_nums or 81, "use_random": use_random},
+               out_slots=("Rois", "LabelsInt32", "BboxTargets",
+                          "BboxInsideWeights", "BboxOutsideWeights"),
+               dtypes=(None, "int32", None, None, None),
+               name=name, stop_gradient=True)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    """Route RoIs to FPN levels (reference: detection.py:2433). Returns
+    (multi_rois: list of [N, R, 4] per level, restore_ind [N, R])."""
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_levels = max_level - min_level + 1
+    outs = [helper.create_variable_for_type_inference(
+        dtype=fpn_rois.dtype, stop_gradient=True) for _ in range(n_levels)]
+    nums = [helper.create_variable_for_type_inference(
+        dtype="int32", stop_gradient=True) for _ in range(n_levels)]
+    restore = helper.create_variable_for_type_inference(
+        dtype="int32", stop_gradient=True)
+    helper.append_op(
+        "distribute_fpn_proposals", inputs={"FpnRois": fpn_rois},
+        outputs={"MultiFpnRois": outs, "MultiLevelRoIsNum": nums,
+                 "RestoreInd": restore},
+        attrs={"min_level": min_level, "max_level": max_level,
+               "refer_level": refer_level, "refer_scale": refer_scale})
+    return outs, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    """Merge per-level RoIs by score (reference: detection.py:2569)."""
+    rois, _num = _op("collect_fpn_proposals",
+                     {"MultiLevelRois": list(multi_rois),
+                      "MultiLevelScores": list(multi_scores)},
+                     {"post_nms_topN": post_nms_top_n},
+                     out_slots=("FpnRois", "RoisNum"),
+                     dtypes=(None, "int32"), name=name, stop_gradient=True)
+    return rois
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    """Per-class decode + best-class assign (reference:
+    detection.py:2507)."""
+    return _op("box_decoder_and_assign",
+               {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+                "TargetBox": target_box, "BoxScore": box_score},
+               {"box_clip": box_clip},
+               out_slots=("DecodeBox", "OutputAssignBox"), name=name,
+               stop_gradient=True)

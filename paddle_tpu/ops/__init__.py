@@ -13,6 +13,7 @@ from paddle_tpu.ops import (  # noqa: F401
     control_flow_ops,
     crf_ops,
     decode_ops,
+    detection_ops,
     math_ops,
     moe_ops,
     nn_ops,

@@ -1,0 +1,27 @@
+"""Shared box geometry helpers for the detection op family.
+
+One IoU implementation for every pairwise-xyxy consumer (iou_similarity,
+ssd_loss, rpn/proposal ops, detection_map) so the epsilon/clamp
+conventions can't drift apart. Convention: zero-clamped edge lengths, no
++1 pixel offsets (the reference mixes both across files; ops needing the
++1 legacy convention, e.g. NMS in vision_ops, keep it locally and say
+so)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def xyxy_area(b):
+    return jnp.maximum(b[..., 2] - b[..., 0], 0.0) * jnp.maximum(
+        b[..., 3] - b[..., 1], 0.0)
+
+
+def iou_xyxy(a, b):
+    """Pairwise IoU: a [..., M, 4], b [..., G, 4] -> [..., M, G]."""
+    lt = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    rb = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = xyxy_area(a)[..., :, None] + xyxy_area(b)[..., None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)

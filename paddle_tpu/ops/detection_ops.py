@@ -1,0 +1,798 @@
+"""Detection ops: target assignment, proposals, YOLO/SSD losses, FPN
+routing, mAP.
+
+Reference kernels: paddle/fluid/operators/detection/{target_assign_op.cc,
+mine_hard_examples_op.cc, yolov3_loss_op.h, rpn_target_assign_op.cc,
+generate_proposals_op.cc, generate_proposal_labels_op.cc,
+distribute_fpn_proposals_op.cc, collect_fpn_proposals_op.cc,
+box_decoder_and_assign_op.cc, detection_map_op.cc}.
+
+Dense-padded design (SURVEY.md section 5): where the reference passes
+variable-length LoD tensors (ground-truth boxes per image, sampled
+indices), these ops take fixed-capacity tensors padded with sentinel
+rows — gt boxes with non-positive width/height (YOLO convention,
+yolov3_loss_op.h GtValid) or an explicit count/mask — and return
+fixed-capacity outputs plus weights/masks. Losses contract with the
+weights, so padding never contributes; control flow stays static for
+XLA.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.box_util import iou_xyxy as _iou_xyxy
+from paddle_tpu.ops.box_util import xyxy_area as _xyxy_area
+
+_NEG = -1e9
+
+
+def _x(ins, slot="X", i=0):
+    v = ins.get(slot)
+    return v[i] if v else None
+
+
+def _decode_anchor(anchors, deltas, variances=None):
+    """Decode bbox deltas against xyxy anchors (decode_center_size with
+    per-anchor variances; reference generate_proposals_op.cc BoxCoder)."""
+    aw = anchors[..., 2] - anchors[..., 0] + 1.0
+    ah = anchors[..., 3] - anchors[..., 1] + 1.0
+    ax = anchors[..., 0] + aw * 0.5
+    ay = anchors[..., 1] + ah * 0.5
+    dx, dy, dw, dh = (deltas[..., 0], deltas[..., 1], deltas[..., 2],
+                      deltas[..., 3])
+    if variances is not None:
+        dx = dx * variances[..., 0]
+        dy = dy * variances[..., 1]
+        dw = dw * variances[..., 2]
+        dh = dh * variances[..., 3]
+    # kBBoxClipDefault = log(1000/16): keeps exp() finite for wild deltas
+    clip = jnp.log(1000.0 / 16.0)
+    cx = dx * aw + ax
+    cy = dy * ah + ay
+    w = jnp.exp(jnp.minimum(dw, clip)) * aw
+    h = jnp.exp(jnp.minimum(dh, clip)) * ah
+    return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                      cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], axis=-1)
+
+
+@register_op("target_assign", no_grad=True)
+def _target_assign(ins, attrs):
+    """Gather targets by match indices (reference: target_assign_op.cc).
+
+    X [N, G, K] per-image entities (dense analog of the LoD rows),
+    MatchIndices [N, P] int32 (-1 = unmatched), optional NegIndices
+    [N, S] int32 (-1 padding). Out [N, P, K], OutWeight [N, P, 1].
+    """
+    x = _x(ins)
+    match = _x(ins, "MatchIndices")
+    neg = _x(ins, "NegIndices")
+    mismatch = attrs.get("mismatch_value", 0.0)
+    safe = jnp.maximum(match, 0)
+    out = jnp.take_along_axis(x, safe[..., None], axis=1)
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch, x.dtype))
+    weight = matched.astype(x.dtype)
+    if neg is not None:
+        n, p = match.shape
+        neg_hit = jnp.zeros((n, p), bool)
+        cols = jnp.maximum(neg, 0)
+        neg_hit = jax.vmap(
+            lambda h, c, m: h.at[c].max(m)
+        )(neg_hit, cols, neg >= 0)
+        out = jnp.where(neg_hit[..., None] & ~matched,
+                        jnp.asarray(mismatch, x.dtype), out)
+        weight = jnp.maximum(weight, neg_hit[..., None].astype(x.dtype))
+    return {"Out": [out], "OutWeight": [weight]}
+
+
+@register_op("mine_hard_examples", no_grad=True)
+def _mine_hard_examples(ins, attrs):
+    """Hard-negative mining (reference: mine_hard_examples_op.cc,
+    max_negative mode): per image, rank unmatched priors by loss and keep
+    the top ``neg_pos_ratio * num_pos`` (at least ``sample_size`` when
+    set). NegIndices [N, P] int32, -1 padded; UpdatedMatchIndices keeps
+    matches, sets mined negatives to -1 (they already are)."""
+    cls_loss = _x(ins, "ClsLoss")
+    loc_loss = _x(ins, "LocLoss")
+    match = _x(ins, "MatchIndices")
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    overlap = attrs.get("neg_dist_threshold", 0.5)
+    sample_size = int(attrs.get("sample_size", 0))
+    dist = _x(ins, "MatchDist")
+    loss = cls_loss.astype(jnp.float32)
+    if loc_loss is not None and attrs.get("mining_type",
+                                          "max_negative") == "hard_example":
+        loss = loss + loc_loss.astype(jnp.float32)
+    n, p = match.shape
+    is_neg = match < 0
+    if dist is not None:
+        is_neg = is_neg & (dist < overlap)
+    num_pos = jnp.sum(match >= 0, axis=1)
+    num_neg = jnp.sum(is_neg, axis=1)
+    want = (jnp.minimum((num_pos * ratio).astype(jnp.int32), num_neg)
+            if sample_size == 0
+            else jnp.minimum(jnp.int32(sample_size), num_neg))
+    masked = jnp.where(is_neg, loss, _NEG)
+    order = jnp.argsort(-masked, axis=1)  # hardest negatives first
+    rank = jnp.arange(p)[None, :]
+    neg_idx = jnp.where(rank < want[:, None], order.astype(jnp.int32), -1)
+    return {"NegIndices": [neg_idx], "UpdatedMatchIndices": [match]}
+
+
+def _yolo_grids(x, anchors, anchor_mask, class_num, downsample):
+    n, c, h, w = x.shape
+    m = len(anchor_mask)
+    xr = x.reshape(n, m, 5 + class_num, h, w)
+    input_size = downsample * h
+    gx = (jnp.arange(w, dtype=jnp.float32))[None, None, None, :]
+    gy = (jnp.arange(h, dtype=jnp.float32))[None, None, :, None]
+    aw = jnp.asarray([anchors[2 * i] for i in anchor_mask], jnp.float32)
+    ah = jnp.asarray([anchors[2 * i + 1] for i in anchor_mask], jnp.float32)
+    px = (gx + jax.nn.sigmoid(xr[:, :, 0])) / w
+    py = (gy + jax.nn.sigmoid(xr[:, :, 1])) / h
+    pw = jnp.exp(xr[:, :, 2]) * aw[None, :, None, None] / input_size
+    ph = jnp.exp(xr[:, :, 3]) * ah[None, :, None, None] / input_size
+    return xr, (px, py, pw, ph), input_size
+
+
+def _iou_cxcywh(ax, ay, aw, ah, bx, by, bw, bh):
+    """IoU of center-format boxes (broadcasting)."""
+    l = jnp.maximum(ax - aw / 2, bx - bw / 2)
+    r = jnp.minimum(ax + aw / 2, bx + bw / 2)
+    t = jnp.maximum(ay - ah / 2, by - bh / 2)
+    b = jnp.minimum(ay + ah / 2, by + bh / 2)
+    inter = jnp.maximum(r - l, 0.0) * jnp.maximum(b - t, 0.0)
+    union = aw * ah + bw * bh - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+def _bce_logits(logit, target):
+    return jnp.maximum(logit, 0.0) - logit * target + jnp.log1p(
+        jnp.exp(-jnp.abs(logit)))
+
+
+@register_op("yolov3_loss", diff_inputs=("X",))
+def _yolov3_loss(ins, attrs):
+    """YOLOv3 loss (reference: yolov3_loss_op.h). X [N, m*(5+C), H, W],
+    GTBox [N, B, 4] center-format (x, y, w, h) normalized to [0, 1]
+    (rows with w or h <= 0 are padding), GTLabel [N, B] int, optional
+    GTScore [N, B] (mixup). Loss [N]; aux ObjectnessMask, GTMatchMask."""
+    x = _x(ins)
+    gt_box = _x(ins, "GTBox").astype(jnp.float32)
+    gt_label = _x(ins, "GTLabel")
+    gt_score = _x(ins, "GTScore")
+    anchors = [int(a) for a in attrs["anchors"]]
+    anchor_mask = [int(a) for a in attrs["anchor_mask"]]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    smooth = bool(attrs.get("use_label_smooth", True))
+    n, c, h, w = x.shape
+    m = len(anchor_mask)
+    b = gt_box.shape[1]
+    xf = x.astype(jnp.float32)
+    xr, (px, py, pw, ph), input_size = _yolo_grids(
+        xf, anchors, anchor_mask, class_num, downsample)
+    if gt_score is None:
+        gt_score = jnp.ones((n, b), jnp.float32)
+    valid = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)       # [N, B]
+
+    # ignore mask: best IoU of each predicted box over valid gts
+    iou = _iou_cxcywh(
+        px[..., None], py[..., None], pw[..., None], ph[..., None],
+        gt_box[:, None, None, None, :, 0], gt_box[:, None, None, None, :, 1],
+        gt_box[:, None, None, None, :, 2], gt_box[:, None, None, None, :, 3])
+    iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+    best_iou = jnp.max(iou, axis=-1)                          # [N, m, H, W]
+
+    # per-gt best anchor over the FULL anchor set (shifted to origin)
+    an_num = len(anchors) // 2
+    aw_all = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+    ah_all = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+    gt_an_iou = _iou_cxcywh(
+        jnp.zeros(()), jnp.zeros(()), gt_box[..., 2:3], gt_box[..., 3:4],
+        jnp.zeros(()), jnp.zeros(()), aw_all[None, None, :],
+        ah_all[None, None, :])                                # [N, B, A]
+    best_n = jnp.argmax(gt_an_iou, axis=-1)                   # [N, B]
+    mask_lut = -jnp.ones((an_num,), jnp.int32)
+    for pos, a in enumerate(anchor_mask):
+        mask_lut = mask_lut.at[a].set(pos)
+    mask_idx = jnp.where(valid, mask_lut[best_n], -1)         # [N, B]
+    sel = valid & (mask_idx >= 0)
+
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    midx = jnp.maximum(mask_idx, 0)
+    bidx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, b))
+
+    # gather the responsible cell's logits per gt: [N, B, 5+C]
+    cell = xr[bidx, midx, :, gj, gi]
+    tx = gt_box[..., 0] * w - gi
+    ty = gt_box[..., 1] * h - gj
+    sel_aw = jnp.asarray(anchors[0::2], jnp.float32)[best_n]
+    sel_ah = jnp.asarray(anchors[1::2], jnp.float32)[best_n]
+    tw = jnp.log(jnp.maximum(gt_box[..., 2] * input_size, 1e-9) /
+                 jnp.maximum(sel_aw, 1e-9))
+    th = jnp.log(jnp.maximum(gt_box[..., 3] * input_size, 1e-9) /
+                 jnp.maximum(sel_ah, 1e-9))
+    scale = (2.0 - gt_box[..., 2] * gt_box[..., 3]) * gt_score
+    loc = (_bce_logits(cell[..., 0], tx) + _bce_logits(cell[..., 1], ty)
+           + jnp.abs(cell[..., 2] - tw) + jnp.abs(cell[..., 3] - th))
+    loc_loss = jnp.sum(jnp.where(sel, loc * scale, 0.0), axis=1)
+
+    if smooth and class_num > 1:
+        pos_t, neg_t = 1.0 - 1.0 / class_num, 1.0 / class_num
+    else:
+        pos_t, neg_t = 1.0, 0.0
+    onehot = jax.nn.one_hot(gt_label, class_num, dtype=jnp.float32)
+    tcls = onehot * pos_t + (1.0 - onehot) * neg_t
+    cls = jnp.sum(_bce_logits(cell[..., 5:], tcls), axis=-1)
+    cls_loss = jnp.sum(jnp.where(sel, cls * gt_score, 0.0), axis=1)
+
+    # objectness mask: score at responsible cells, -1 where ignored
+    obj = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)      # [N, m, H, W]
+    obj = obj.at[bidx, midx, gj, gi].set(
+        jnp.where(sel, gt_score, obj[bidx, midx, gj, gi]))
+    obj = jax.lax.stop_gradient(obj)
+    obj_logit = xr[:, :, 4]
+    obj_loss = jnp.sum(
+        jnp.where(obj > 1e-5, _bce_logits(obj_logit, 1.0) * obj,
+                  jnp.where(obj > -0.5, _bce_logits(obj_logit, 0.0), 0.0)),
+        axis=(1, 2, 3))
+
+    loss = loc_loss + cls_loss + obj_loss
+    return {
+        "Loss": [loss],
+        "ObjectnessMask": [obj],
+        "GTMatchMask": [jax.lax.stop_gradient(mask_idx)],
+    }
+
+
+@register_op("ssd_loss", diff_inputs=("Location", "Confidence"))
+def _ssd_loss(ins, attrs):
+    """Fused SSD multibox loss (reference: layers/detection.py ssd_loss —
+    bipartite match + hard-negative mining + target assign + smooth-l1 +
+    softmax CE). The reference composes ~10 LoD ops; here the whole loss
+    is one fused dense computation (targets/masks under stop_gradient,
+    XLA fuses the rest). Location [N, P, 4], Confidence [N, P, C],
+    GtBox [N, G, 4] xyxy (zero-area rows padding), GtLabel [N, G] int,
+    PriorBox [P, 4], PriorBoxVar [P, 4] optional. Loss [N, 1]."""
+    loc = _x(ins, "Location")
+    conf = _x(ins, "Confidence")
+    gt_box = _x(ins, "GtBox").astype(jnp.float32)
+    gt_label = _x(ins, "GtLabel")
+    prior = _x(ins, "PriorBox").astype(jnp.float32)
+    pvar = _x(ins, "PriorBoxVar")
+    bg = int(attrs.get("background_label", 0))
+    overlap_t = float(attrs.get("overlap_threshold", 0.5))
+    neg_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_overlap = float(attrs.get("neg_overlap", 0.5))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    match_type = attrs.get("match_type", "per_prediction")
+    normalize = bool(attrs.get("normalize", True))
+    n, p, c = conf.shape
+    g = gt_box.shape[1]
+    gt_valid = _xyxy_area(gt_box) > 0                          # [N, G]
+    iou = _iou_xyxy(gt_box, prior[None].repeat(n, 0))          # [N, G, P]
+    iou = jnp.where(gt_valid[..., None], iou, -1.0)
+
+    def match_one(d):
+        def body(_, state):
+            col_match, dd = state
+            idx = jnp.argmax(dd)
+            rr, cc = idx // p, idx % p
+            ok = dd[rr, cc] > 0
+            col_match = jnp.where(ok, col_match.at[cc].set(rr), col_match)
+            dd = jnp.where(ok, dd.at[rr, :].set(-1.0).at[:, cc].set(-1.0),
+                           dd)
+            return col_match, dd
+
+        col0 = jnp.full((p,), -1, jnp.int32)
+        col_match, _ = jax.lax.fori_loop(0, min(g, p), body, (col0, d))
+        if match_type == "per_prediction":
+            # unmatched priors additionally match their best gt above
+            # overlap_threshold (reference bipartite_match_op.cc)
+            best = jnp.argmax(d, 0).astype(jnp.int32)
+            best_d = jnp.max(d, 0)
+            col_match = jnp.where(
+                (col_match < 0) & (best_d > overlap_t), best, col_match)
+        dist = jnp.where(
+            col_match >= 0,
+            jnp.take_along_axis(d, jnp.maximum(col_match, 0)[None], 0)[0],
+            0.0)
+        return col_match, dist
+
+    match, match_dist = jax.vmap(match_one)(iou)               # [N, P]
+    matched = match >= 0
+    safe = jnp.maximum(match, 0)
+    tlabel = jnp.where(matched, jnp.take_along_axis(
+        gt_label.astype(jnp.int32), safe, 1), bg)
+
+    conf_f = conf.astype(jnp.float32)
+    lse = jax.nn.logsumexp(conf_f, axis=-1)
+    pick = jnp.take_along_axis(conf_f, tlabel[..., None], -1)[..., 0]
+    conf_ce = lse - pick                                       # [N, P]
+
+    # hard-negative mining on the pre-assignment CE (max_negative)
+    is_neg = ~matched & (match_dist < neg_overlap)
+    num_pos = jnp.sum(matched, 1)
+    want = jnp.minimum((num_pos * neg_ratio).astype(jnp.int32),
+                       jnp.sum(is_neg, 1))[:, None]
+    masked_loss = jnp.where(is_neg, jax.lax.stop_gradient(conf_ce), _NEG)
+    order = jnp.argsort(-masked_loss, 1)
+    rank = jnp.zeros((n, p), jnp.int32).at[
+        jnp.arange(n)[:, None], order].set(
+            jnp.arange(p, dtype=jnp.int32)[None])
+    neg_sel = is_neg & (rank < want)
+
+    # regression targets: encode matched gt against priors
+    mg = jnp.take_along_axis(gt_box, safe[..., None], 1)       # [N, P, 4]
+    aw = prior[:, 2] - prior[:, 0]
+    ah = prior[:, 3] - prior[:, 1]
+    ax = prior[:, 0] + aw * 0.5
+    ay = prior[:, 1] + ah * 0.5
+    gw = jnp.maximum(mg[..., 2] - mg[..., 0], 1e-6)
+    gh = jnp.maximum(mg[..., 3] - mg[..., 1], 1e-6)
+    gx = mg[..., 0] + gw * 0.5
+    gy = mg[..., 1] + gh * 0.5
+    tgt = jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                     jnp.log(gw / aw), jnp.log(gh / ah)], -1)
+    if pvar is not None:
+        tgt = tgt / pvar.astype(jnp.float32)[None]
+    tgt = jax.lax.stop_gradient(jnp.where(matched[..., None], tgt, 0.0))
+
+    diff = loc.astype(jnp.float32) - tgt
+    ad = jnp.abs(diff)
+    sl1 = jnp.sum(jnp.where(ad < 1.0, 0.5 * ad * ad, ad - 0.5), -1)
+    loc_loss = jnp.where(matched, sl1, 0.0)
+    conf_loss = jnp.where(matched | neg_sel, conf_ce, 0.0)
+    loss = conf_w * conf_loss + loc_w * loc_loss               # [N, P]
+    loss = jnp.sum(loss, 1, keepdims=True)
+    if normalize:
+        norm = jnp.maximum(jnp.sum(matched.astype(jnp.float32)), 1.0)
+        loss = loss / norm
+    return {"Loss": [loss.astype(loc.dtype)]}
+
+
+@register_op("rpn_target_assign", no_grad=True, needs_rng=True)
+def _rpn_target_assign(ins, attrs, rng=None):
+    """Dense RPN anchor labelling (reference: rpn_target_assign_op.cc).
+
+    Anchor [M, 4], GtBoxes [N, G, 4] (zero-area rows are padding),
+    ImInfo [N, 3]. Outputs per-anchor dense targets instead of gathered
+    LoD rows: ScoreLabel [N, M] f32 (1 pos / 0 neg / -1 ignored),
+    ScoreWeight [N, M] (1 on sampled pos+neg), BboxTarget [N, M, 4]
+    encoded regression targets, BboxWeight [N, M, 4] (1 on sampled pos).
+    Losses contract with the weights, which is the static-shape analog of
+    the reference's gathered index lists."""
+    anchors = _x(ins, "Anchor")
+    gt = _x(ins, "GtBoxes").astype(jnp.float32)
+    im_info = _x(ins, "ImInfo")
+    is_crowd = _x(ins, "IsCrowd")
+    batch_per_im = int(attrs.get("rpn_batch_size_per_im", 256))
+    straddle = float(attrs.get("rpn_straddle_thresh", 0.0))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_ov = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_ov = float(attrs.get("rpn_negative_overlap", 0.3))
+    use_random = bool(attrs.get("use_random", True))
+    m = anchors.shape[0]
+    n, g = gt.shape[0], gt.shape[1]
+    gt_valid = _xyxy_area(gt) > 0                              # [N, G]
+    if is_crowd is not None:
+        # crowd gt boxes are dropped before labelling (reference
+        # rpn_target_assign_op.cc filters is_crowd rows out)
+        gt_valid = gt_valid & (is_crowd == 0)
+
+    if straddle >= 0 and im_info is not None:
+        hgt, wid = im_info[:, 0:1], im_info[:, 1:2]            # [N, 1]
+        inside = ((anchors[None, :, 0] >= -straddle)
+                  & (anchors[None, :, 1] >= -straddle)
+                  & (anchors[None, :, 2] < wid + straddle)
+                  & (anchors[None, :, 3] < hgt + straddle))    # [N, M]
+    else:
+        inside = jnp.ones((n, m), bool)
+
+    iou = _iou_xyxy(anchors[None], gt)                         # [N, M, G]
+    iou = jnp.where(gt_valid[:, None, :] & inside[..., None], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=2)                          # [N, M]
+    best_iou = jnp.max(iou, axis=2)
+    # (i) anchors with max IoU per gt are positive even below threshold
+    gt_best = jnp.max(iou, axis=1, keepdims=True)              # [N, 1, G]
+    is_gt_best = jnp.any(
+        (iou >= gt_best) & (gt_best > 0) & gt_valid[:, None, :], axis=2)
+    pos = (best_iou >= pos_ov) | is_gt_best
+    neg = (best_iou < neg_ov) & (best_iou >= 0) & ~pos
+
+    def sample(mask, want, key):
+        score = jax.random.uniform(key, mask.shape) if use_random else (
+            -jnp.arange(m, dtype=jnp.float32) / m)[None]
+        score = jnp.where(mask, score, -1.0)
+        order = jnp.argsort(-score, axis=1)
+        rank = jnp.zeros((n, m), jnp.int32).at[
+            jnp.arange(n)[:, None], order].set(
+                jnp.arange(m, dtype=jnp.int32)[None, :])
+        return mask & (rank < want)
+
+    k1, k2 = (jax.random.split(rng) if rng is not None
+              else (jax.random.key(0), jax.random.key(1)))
+    want_fg = jnp.minimum(int(batch_per_im * fg_frac),
+                          jnp.sum(pos, 1))[:, None]
+    fg_sel = sample(pos, want_fg, k1)
+    want_bg = jnp.minimum(batch_per_im - jnp.sum(fg_sel, 1),
+                          jnp.sum(neg, 1))[:, None]
+    bg_sel = sample(neg, want_bg, k2)
+
+    matched_gt = jnp.take_along_axis(gt, best_gt[..., None], 1)  # [N, M, 4]
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    ax = anchors[:, 0] + aw * 0.5
+    ay = anchors[:, 1] + ah * 0.5
+    gw = matched_gt[..., 2] - matched_gt[..., 0] + 1.0
+    gh = matched_gt[..., 3] - matched_gt[..., 1] + 1.0
+    gx = matched_gt[..., 0] + gw * 0.5
+    gy = matched_gt[..., 1] + gh * 0.5
+    tgt = jnp.stack([(gx - ax) / aw, (gy - ay) / ah,
+                     jnp.log(gw / aw), jnp.log(gh / ah)], axis=-1)
+    label = jnp.where(fg_sel, 1.0, jnp.where(bg_sel, 0.0, -1.0))
+    return {
+        "ScoreLabel": [label],
+        "ScoreWeight": [(fg_sel | bg_sel).astype(jnp.float32)],
+        "BboxTarget": [jnp.where(fg_sel[..., None], tgt, 0.0)],
+        "BboxWeight": [jnp.broadcast_to(
+            fg_sel[..., None], tgt.shape).astype(jnp.float32)],
+    }
+
+
+def _nms_mask(boxes, scores, thresh, top_k):
+    """Greedy NMS keep-mask over [K, 4] boxes (scores descending order
+    assumed). Returns keep mask [K]."""
+    k = boxes.shape[0]
+    iou = _iou_xyxy(boxes, boxes)
+
+    def body(i, keep):
+        sup = jnp.any((iou[i] > thresh) & keep & (jnp.arange(k) < i))
+        return keep.at[i].set(keep[i] & ~sup)
+
+    keep0 = scores > _NEG / 2
+    keep = jax.lax.fori_loop(0, k, body, keep0)
+    if top_k > 0:
+        keep = keep & (jnp.cumsum(keep) <= top_k)
+    return keep
+
+
+@register_op("generate_proposals", no_grad=True)
+def _generate_proposals(ins, attrs):
+    """RPN proposal generation (reference: generate_proposals_op.cc).
+    Scores [N, A, H, W], BboxDeltas [N, 4A, H, W], ImInfo [N, 3],
+    Anchors [H, W, A, 4], Variances like Anchors. Dense outputs:
+    RpnRois [N, post_nms_topN, 4] (rows beyond RpnRoisNum are zero),
+    RpnRoiProbs [N, post_nms_topN, 1], RpnRoisNum [N]."""
+    scores = _x(ins, "Scores")
+    deltas = _x(ins, "BboxDeltas")
+    im_info = _x(ins, "ImInfo")
+    anchors = _x(ins, "Anchors").reshape(-1, 4)
+    variances = _x(ins, "Variances")
+    if variances is not None:
+        variances = variances.reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.5))
+    min_size = float(attrs.get("min_size", 0.1))
+    n, a, h, w = scores.shape
+    total = a * h * w
+    # [N, A, H, W] -> [N, H*W*A] matching anchors' [H, W, A] order
+    sc = scores.transpose(0, 2, 3, 1).reshape(n, total).astype(jnp.float32)
+    dl = (deltas.reshape(n, a, 4, h, w).transpose(0, 3, 4, 1, 2)
+          .reshape(n, total, 4).astype(jnp.float32))
+    pre_n = min(pre_n, total)
+    top_sc, top_idx = jax.lax.top_k(sc, pre_n)
+    top_dl = jnp.take_along_axis(dl, top_idx[..., None], 1)
+    top_an = anchors[top_idx]
+    top_var = variances[top_idx] if variances is not None else None
+    props = _decode_anchor(top_an, top_dl, top_var)
+    hgt, wid = im_info[:, 0:1, None], im_info[:, 1:2, None]
+    props = jnp.concatenate([
+        jnp.clip(props[..., 0:1], 0.0, wid - 1.0),
+        jnp.clip(props[..., 1:2], 0.0, hgt - 1.0),
+        jnp.clip(props[..., 2:3], 0.0, wid - 1.0),
+        jnp.clip(props[..., 3:4], 0.0, hgt - 1.0)], axis=-1)
+    ws = props[..., 2] - props[..., 0] + 1.0
+    hs = props[..., 3] - props[..., 1] + 1.0
+    min_sz = jnp.maximum(min_size, 1.0) * im_info[:, 2:3]
+    alive = (ws >= min_sz) & (hs >= min_sz)
+    top_sc = jnp.where(alive, top_sc, _NEG)
+
+    def per_image(boxes, sc):
+        order = jnp.argsort(-sc)
+        boxes, sc = boxes[order], sc[order]
+        keep = _nms_mask(boxes, sc, nms_thresh, post_n)
+        sc = jnp.where(keep, sc, _NEG)
+        order2 = jnp.argsort(-sc)[:post_n]
+        out_b = jnp.where((sc[order2] > _NEG / 2)[:, None],
+                          boxes[order2], 0.0)
+        out_s = jnp.where(sc[order2] > _NEG / 2, sc[order2], 0.0)
+        return out_b, out_s, jnp.sum(sc > _NEG / 2).astype(jnp.int32)
+
+    rois, probs, num = jax.vmap(per_image)(props, top_sc)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs[..., None]],
+            "RpnRoisNum": [num]}
+
+
+@register_op("generate_proposal_labels", no_grad=True, needs_rng=True)
+def _generate_proposal_labels(ins, attrs, rng=None):
+    """Sample RoIs for the second stage (reference:
+    generate_proposal_labels_op.cc). RpnRois [N, R, 4], GtClasses [N, G],
+    GtBoxes [N, G, 4] (zero-area padding), ImInfo [N, 3]. Outputs a fixed
+    ``batch_size_per_im`` sample per image: Rois [N, B, 4],
+    LabelsInt32 [N, B] (-1 on unused slots), BboxTargets
+    [N, B, 4*class_nums], plus inside/outside weights of the same shape
+    (1 on the foreground slots' class columns)."""
+    rois = _x(ins, "RpnRois").astype(jnp.float32)
+    gt_classes = _x(ins, "GtClasses")
+    gt_boxes = _x(ins, "GtBoxes").astype(jnp.float32)
+    is_crowd = _x(ins, "IsCrowd")
+    batch = int(attrs.get("batch_size_per_im", 512))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    class_nums = int(attrs.get("class_nums", 81))
+    use_random = bool(attrs.get("use_random", True))
+    n, r = rois.shape[:2]
+    g = gt_boxes.shape[1]
+    gt_valid = _xyxy_area(gt_boxes) > 0
+    if is_crowd is not None:
+        # crowd regions are excluded from sampling entirely
+        # (reference generate_proposal_labels filters them out)
+        gt_valid = gt_valid & (is_crowd == 0)
+    # gt boxes join the candidate pool (reference appends them); rois
+    # with zero area are generate_proposals padding, not candidates
+    cand = jnp.concatenate([rois, gt_boxes], axis=1)           # [N, R+G, 4]
+    cand_valid = jnp.concatenate(
+        [_xyxy_area(rois) > 0, gt_valid], axis=1)
+    iou = _iou_xyxy(cand, gt_boxes)
+    # invalid gt rows contribute 0 overlap (a valid roi with no gt is
+    # background, matching the reference); invalid CANDIDATES get -1 so
+    # they can never satisfy fg or bg thresholds
+    iou = jnp.where(gt_valid[:, None, :], iou, 0.0)
+    iou = jnp.where(cand_valid[..., None], iou, -1.0)
+    best_gt = jnp.argmax(iou, 2)
+    best_iou = jnp.max(iou, 2)
+    fg = best_iou >= fg_thresh
+    bg = (best_iou < bg_hi) & (best_iou >= bg_lo)
+    k1, k2 = (jax.random.split(rng) if rng is not None
+              else (jax.random.key(0), jax.random.key(1)))
+    total = cand.shape[1]
+
+    def sample(mask, want, key):
+        sc = (jax.random.uniform(key, mask.shape) if use_random
+              else -jnp.arange(total, dtype=jnp.float32)[None] / total)
+        sc = jnp.where(mask, sc, -1.0)
+        order = jnp.argsort(-sc, 1)
+        rank = jnp.zeros_like(order).at[
+            jnp.arange(n)[:, None], order].set(
+                jnp.arange(total, dtype=order.dtype)[None])
+        return mask & (rank < want)
+
+    want_fg = jnp.minimum(int(batch * fg_frac), jnp.sum(fg, 1))[:, None]
+    fg_sel = sample(fg, want_fg, k1)
+    want_bg = jnp.minimum(batch - jnp.sum(fg_sel, 1), jnp.sum(bg, 1))[:, None]
+    bg_sel = sample(bg, want_bg, k2)
+
+    # compact: fg rows first, then bg, padded to `batch`
+    key_order = jnp.where(fg_sel, 0, jnp.where(bg_sel, 1, 2))
+    order = jnp.argsort(key_order, axis=1, stable=True)[:, :batch]
+    take = lambda v: jnp.take_along_axis(v, order, 1)
+    sel_rois = jnp.take_along_axis(cand, order[..., None], 1)
+    sel_gt = jnp.take_along_axis(best_gt, order, 1)
+    sel_fg = take(fg_sel)
+    sel_used = take(fg_sel | bg_sel)
+    labels = jnp.take_along_axis(gt_classes, sel_gt, 1)
+    labels = jnp.where(sel_fg, labels,
+                       jnp.where(sel_used, 0, -1)).astype(jnp.int32)
+    matched = jnp.take_along_axis(gt_boxes, sel_gt[..., None], 1)
+    rw = sel_rois[..., 2] - sel_rois[..., 0] + 1.0
+    rh = sel_rois[..., 3] - sel_rois[..., 1] + 1.0
+    rx = sel_rois[..., 0] + rw * 0.5
+    ry = sel_rois[..., 1] + rh * 0.5
+    gw = matched[..., 2] - matched[..., 0] + 1.0
+    gh = matched[..., 3] - matched[..., 1] + 1.0
+    gx = matched[..., 0] + gw * 0.5
+    gy = matched[..., 1] + gh * 0.5
+    tgt = jnp.stack([(gx - rx) / rw, (gy - ry) / rh,
+                     jnp.log(gw / rw), jnp.log(gh / rh)], -1)  # [N, B, 4]
+    onehot = jax.nn.one_hot(jnp.maximum(labels, 0), class_nums)
+    col = (onehot[..., None] *
+           jnp.where(sel_fg[..., None], tgt, 0.0)[:, :, None, :])
+    bbox_targets = col.reshape(n, batch, 4 * class_nums)
+    w_in = jnp.broadcast_to(
+        (onehot * sel_fg[..., None])[..., None],
+        (n, batch, class_nums, 4)).reshape(n, batch, 4 * class_nums)
+    return {
+        "Rois": [jnp.where(sel_used[..., None], sel_rois, 0.0)],
+        "LabelsInt32": [labels],
+        "BboxTargets": [bbox_targets],
+        "BboxInsideWeights": [w_in],
+        "BboxOutsideWeights": [w_in],
+    }
+
+
+@register_op("distribute_fpn_proposals", no_grad=True)
+def _distribute_fpn_proposals(ins, attrs):
+    """Route RoIs to FPN levels by scale (reference:
+    distribute_fpn_proposals_op.cc): level = clip(floor(refer_level +
+    log2(sqrt(area) / refer_scale)), min_level, max_level). FpnRois
+    [N, R, 4] (zero rows = padding). Outputs one [N, R, 4] tensor per
+    level with non-level rows zeroed and compacted to the front,
+    per-level counts, and RestoreInd [N, R] mapping
+    concat-of-level-compactions back to input order."""
+    rois = _x(ins, "FpnRois").astype(jnp.float32)
+    min_level = int(attrs.get("min_level", 2))
+    max_level = int(attrs.get("max_level", 5))
+    refer_level = int(attrs.get("refer_level", 4))
+    refer_scale = int(attrs.get("refer_scale", 224))
+    n, r = rois.shape[:2]
+    area = _xyxy_area(rois)
+    valid = area > 0
+    lvl = jnp.floor(refer_level + jnp.log2(
+        jnp.sqrt(jnp.maximum(area, 1e-6)) / refer_scale + 1e-12))
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    lvl = jnp.where(valid, lvl, max_level + 1)
+    outs, nums = [], []
+    pos_in_concat = jnp.zeros((n, r), jnp.int32)
+    for li, level in enumerate(range(min_level, max_level + 1)):
+        mask = lvl == level
+        order = jnp.argsort(~mask, axis=1, stable=True)        # level first
+        sel = jnp.take_along_axis(rois, order[..., None], 1)
+        cnt = jnp.sum(mask, 1).astype(jnp.int32)
+        keep = jnp.arange(r)[None] < cnt[:, None]
+        outs.append(jnp.where(keep[..., None], sel, 0.0))
+        nums.append(cnt)
+        rank = (jnp.cumsum(mask, axis=1) - 1).astype(jnp.int32)
+        # position in the PADDED concat of the per-level outputs (each
+        # level occupies a fixed r-row band, unlike the reference's LoD
+        # concat): level_band_start + rank-within-level
+        pos_in_concat = jnp.where(mask, li * r + rank, pos_in_concat)
+    restore = jnp.where(valid, pos_in_concat, -1)
+    return {"MultiFpnRois": outs,
+            "MultiLevelRoIsNum": nums,
+            "RestoreInd": [restore]}
+
+
+@register_op("collect_fpn_proposals", no_grad=True)
+def _collect_fpn_proposals(ins, attrs):
+    """Merge per-level RoIs by score (reference:
+    collect_fpn_proposals_op.cc): concat levels, keep global top
+    ``post_nms_topN``. MultiLevelRois: list of [N, R_l, 4];
+    MultiLevelScores: list of [N, R_l] (or [N, R_l, 1]); zero-area rows
+    are padding. Output FpnRois [N, K, 4] + RoisNum [N]."""
+    rois_l = list(ins.get("MultiLevelRois", []))
+    scores_l = list(ins.get("MultiLevelScores", []))
+    post = int(attrs.get("post_nms_topN", 1000))
+    rois = jnp.concatenate([x.astype(jnp.float32) for x in rois_l], axis=1)
+    scores = jnp.concatenate(
+        [s.reshape(s.shape[0], -1).astype(jnp.float32) for s in scores_l],
+        axis=1)
+    valid = _xyxy_area(rois) > 0
+    scores = jnp.where(valid, scores, _NEG)
+    k = min(post, rois.shape[1])
+    top_sc, top_idx = jax.lax.top_k(scores, k)
+    out = jnp.take_along_axis(rois, top_idx[..., None], 1)
+    alive = top_sc > _NEG / 2
+    return {"FpnRois": [jnp.where(alive[..., None], out, 0.0)],
+            "RoisNum": [jnp.sum(alive, 1).astype(jnp.int32)]}
+
+
+@register_op("box_decoder_and_assign", no_grad=True)
+def _box_decoder_and_assign(ins, attrs):
+    """Decode per-class bbox deltas and pick the best class's box
+    (reference: box_decoder_and_assign_op.cc). PriorBox [P, 4],
+    PriorBoxVar [4] or [P, 4], TargetBox [P, 4*C], BoxScore [P, C]."""
+    prior = _x(ins, "PriorBox").astype(jnp.float32)
+    pvar = _x(ins, "PriorBoxVar")
+    target = _x(ins, "TargetBox").astype(jnp.float32)
+    score = _x(ins, "BoxScore").astype(jnp.float32)
+    box_clip = float(attrs.get("box_clip", jnp.log(1000.0 / 16.0)))
+    p = prior.shape[0]
+    c = score.shape[1]
+    deltas = target.reshape(p, c, 4)
+    if pvar is not None:
+        pvar = pvar.astype(jnp.float32)
+        var = pvar if pvar.ndim == 2 else jnp.broadcast_to(pvar[None], (p, 4))
+        deltas = deltas * var[:, None, :]
+    aw = prior[:, 2] - prior[:, 0] + 1.0
+    ah = prior[:, 3] - prior[:, 1] + 1.0
+    ax = prior[:, 0] + aw * 0.5
+    ay = prior[:, 1] + ah * 0.5
+    cx = deltas[..., 0] * aw[:, None] + ax[:, None]
+    cy = deltas[..., 1] * ah[:, None] + ay[:, None]
+    w = jnp.exp(jnp.minimum(deltas[..., 2], box_clip)) * aw[:, None]
+    h = jnp.exp(jnp.minimum(deltas[..., 3], box_clip)) * ah[:, None]
+    decoded = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                         cx + w * 0.5 - 1.0, cy + h * 0.5 - 1.0], -1)
+    best = jnp.argmax(score, axis=1)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, -1), 1)[:, 0]
+    return {"DecodeBox": [decoded.reshape(p, c * 4)],
+            "OutputAssignBox": [assigned]}
+
+
+@register_op("detection_map", no_grad=True)
+def _detection_map(ins, attrs):
+    """Batch mAP (reference: detection_map_op.cc, integral mode plus
+    11-point). DetectRes [N, D, 6] rows (label, score, x1, y1, x2, y2)
+    with label < 0 padding; Label [N, G, 5] rows
+    (label, x1, y1, x2, y2) or [N, G, 6] rows
+    (label, difficult, x1, y1, x2, y2), label < 0 padding. With
+    evaluate_difficult=False, difficult gts neither count toward npos
+    nor consume matches (VOC convention). Computes AP per class over the
+    whole batch and averages — the stateless analog of the reference's
+    accumulating metric op."""
+    det = _x(ins, "DetectRes").astype(jnp.float32)
+    gt = _x(ins, "Label").astype(jnp.float32)
+    class_num = int(attrs["class_num"])
+    overlap = float(attrs.get("overlap_threshold", 0.5))
+    ap_type = attrs.get("ap_type", "integral")
+    evaluate_difficult = bool(attrs.get("evaluate_difficult", True))
+    n, d = det.shape[:2]
+    g = gt.shape[1]
+    gt_boxes = gt[..., -4:]
+    gt_label = gt[..., 0]
+    gt_valid = gt_label >= 0
+    if gt.shape[-1] >= 6 and not evaluate_difficult:
+        gt_valid = gt_valid & (gt[..., 1] == 0)
+    det_label, det_score, det_boxes = det[..., 0], det[..., 1], det[..., 2:]
+    det_valid = det_label >= 0
+    iou = _iou_xyxy(det_boxes, gt_boxes)                       # [N, D, G]
+
+    aps = []
+    for cls in range(class_num):
+        gmask = gt_valid & (gt_label == cls)                   # [N, G]
+        dmask = det_valid & (det_label == cls)                 # [N, D]
+        npos = jnp.sum(gmask)
+        # greedy match per image in score order
+        def per_image(sc, dm, ious, gm):
+            order = jnp.argsort(-jnp.where(dm, sc, _NEG))
+
+            def body(k, carry):
+                used, tp = carry
+                di = order[k]
+                ious_k = jnp.where(gm & ~used, ious[di], -1.0)
+                best = jnp.argmax(ious_k)
+                hit = (ious_k[best] >= overlap) & dm[di]
+                used = used.at[best].set(used[best] | hit)
+                tp = tp.at[di].set(hit)
+                return used, tp
+
+            used0 = jnp.zeros((g,), bool)
+            tp0 = jnp.zeros((d,), bool)
+            _, tp = jax.lax.fori_loop(0, d, body, (used0, tp0))
+            return tp
+
+        tp = jax.vmap(per_image)(det_score, dmask, iou, gmask)  # [N, D]
+        sc_flat = jnp.where(dmask, det_score, _NEG).reshape(-1)
+        tp_flat = tp.reshape(-1)
+        order = jnp.argsort(-sc_flat)
+        tp_sorted = tp_flat[order].astype(jnp.float32)
+        alive = (sc_flat[order] > _NEG / 2).astype(jnp.float32)
+        ctp = jnp.cumsum(tp_sorted * alive)
+        cfp = jnp.cumsum((1.0 - tp_sorted) * alive)
+        prec = ctp / jnp.maximum(ctp + cfp, 1.0)
+        rec = ctp / jnp.maximum(npos, 1)
+        if ap_type == "11point":
+            pts = [jnp.max(jnp.where(rec >= t, prec, 0.0))
+                   for t in [i / 10.0 for i in range(11)]]
+            ap = sum(pts) / 11.0
+        else:
+            drec = jnp.diff(jnp.concatenate([jnp.zeros((1,)), rec]))
+            ap = jnp.sum(prec * drec * alive)
+        aps.append(jnp.where(npos > 0, ap, -1.0))
+    aps = jnp.stack(aps)
+    have = aps >= 0
+    m_ap = jnp.sum(jnp.where(have, aps, 0.0)) / jnp.maximum(
+        jnp.sum(have), 1)
+    return {"MAP": [m_ap.astype(jnp.float32)]}

@@ -565,16 +565,11 @@ def _shard_index(ins, attrs):
 def _iou_similarity(ins, attrs):
     """Pairwise IoU of two box sets [N,4] x [M,4] (xmin,ymin,xmax,ymax)
     (reference: operators/detection/iou_similarity_op.cc)."""
+    from paddle_tpu.ops.box_util import iou_xyxy
+
     x = _x(ins)         # [N, 4]
     y = _x(ins, "Y")    # [M, 4]
-    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
-    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
-    wh = jnp.maximum(rb - lt, 0.0)
-    inter = wh[..., 0] * wh[..., 1]
-    ax = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
-    ay = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
-    return {"Out": [inter / jnp.maximum(ax[:, None] + ay[None, :] - inter,
-                                        1e-10)]}
+    return {"Out": [iou_xyxy(x, y)]}
 
 
 @register_op("box_coder", no_grad=True)

@@ -337,9 +337,18 @@ def _density_prior_box(ins, attrs):
 @register_op("bipartite_match", no_grad=True)
 def _bipartite_match(ins, attrs):
     """Greedy bipartite matching (reference:
-    detection/bipartite_match_op.cc). DistMat [m, n] (rows: priors,
-    cols: ground truth)."""
+    detection/bipartite_match_op.cc). DistMat [m, n] (rows: ground
+    truth, cols: priors when fed from iou_similarity(gt, prior)); a
+    batched [N, m, n] input maps per image — the dense analog of the
+    reference's LoD batching."""
     dist = _x(ins, "DistMat")
+    if dist.ndim == 3:
+        outs = jax.vmap(
+            lambda d: _bipartite_match({"DistMat": [d]}, attrs))(dist)
+        return {
+            "ColToRowMatchIndices": [outs["ColToRowMatchIndices"][0][:, 0]],
+            "ColToRowMatchDist": [outs["ColToRowMatchDist"][0][:, 0]],
+        }
     m, n = dist.shape
 
     def body(_, state):
@@ -356,6 +365,15 @@ def _bipartite_match(ins, attrs):
     col0 = jnp.full((n,), -1, jnp.int32)
     col_match, _ = jax.lax.fori_loop(
         0, min(m, n), body, (col0, dist.astype(jnp.float32)))
+    if attrs.get("match_type") == "per_prediction":
+        # unmatched columns additionally take their best row when the
+        # overlap clears dist_threshold (bipartite_match_op.cc
+        # ArgMaxMatch pass)
+        thresh = float(attrs.get("dist_threshold", 0.5))
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_d = jnp.max(dist, axis=0)
+        col_match = jnp.where((col_match < 0) & (best_d >= thresh),
+                              best_row, col_match)
     matched_dist = jnp.where(
         col_match >= 0,
         jnp.take_along_axis(

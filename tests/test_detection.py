@@ -1,0 +1,304 @@
+"""Detection op family + SSD model (reference op set:
+paddle/fluid/operators/detection/; layer set: layers/detection.py:33-57).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.registry import get_op_def
+from tests.op_test import OpHarness
+
+
+def _r(shape, seed, scale=1.0):
+    return (np.random.RandomState(seed).rand(*shape) * scale).astype(
+        np.float32)
+
+
+def _boxes(n, m, seed, size=1.0):
+    r = np.random.RandomState(seed)
+    xy = r.uniform(0, size * 0.7, (n, m, 2))
+    wh = r.uniform(size * 0.05, size * 0.3, (n, m, 2))
+    return np.concatenate([xy, xy + wh], -1).astype(np.float32)
+
+
+def test_target_assign():
+    x = _r((2, 3, 4), 0)
+    match = np.array([[0, -1, 2, 1], [2, 2, -1, -1]], np.int32)
+    h = OpHarness("target_assign", {"X": x, "MatchIndices": match},
+                  {"mismatch_value": 0.5},
+                  out_slots=("Out", "OutWeight"))
+    ref = np.full((2, 4, 4), 0.5, np.float32)
+    w = np.zeros((2, 4, 1), np.float32)
+    for i in range(2):
+        for j in range(4):
+            if match[i, j] >= 0:
+                ref[i, j] = x[i, match[i, j]]
+                w[i, j] = 1.0
+    h.check_output({"Out": ref, "OutWeight": w})
+
+
+def test_target_assign_negative_indices():
+    x = _r((1, 2, 3), 1)
+    match = np.array([[0, -1, -1]], np.int32)
+    neg = np.array([[1, -1]], np.int32)
+    outs = get_op_def("target_assign").compute(
+        {"X": [x], "MatchIndices": [match], "NegIndices": [neg]},
+        {"mismatch_value": 0.0})
+    w = np.asarray(outs["OutWeight"][0])
+    assert w[0, 0, 0] == 1.0 and w[0, 1, 0] == 1.0 and w[0, 2, 0] == 0.0
+
+
+def test_mine_hard_examples():
+    loss = np.array([[0.1, 0.9, 0.5, 0.7, 0.2]], np.float32)
+    match = np.array([[0, -1, -1, -1, -1]], np.int32)
+    outs = get_op_def("mine_hard_examples").compute(
+        {"ClsLoss": [loss], "MatchIndices": [match]},
+        {"neg_pos_ratio": 2.0})
+    neg = np.asarray(outs["NegIndices"][0])
+    # 1 positive -> 2 negatives, hardest first: indices 1 (0.9), 3 (0.7)
+    assert set(neg[0][neg[0] >= 0].tolist()) == {1, 3}
+
+
+def test_ssd_loss_positive_and_grad():
+    n, p, g, c = 2, 16, 3, 5
+    prior = _boxes(1, p, 3)[0]
+    gt = _boxes(n, g, 4)
+    gt[:, -1] = 0.0  # padding row
+    label = np.random.RandomState(5).randint(1, c, (n, g)).astype(np.int64)
+    loc = _r((n, p, 4), 6)
+    conf = _r((n, p, c), 7)
+    h = OpHarness("ssd_loss",
+                  {"Location": loc, "Confidence": conf, "GtBox": gt,
+                   "GtLabel": label, "PriorBox": prior},
+                  {"neg_pos_ratio": 3.0},
+                  out_slots=("Loss",))
+    out = h.forward()[0]
+    assert out.shape == (n, 1) and np.all(out > 0) and np.isfinite(out).all()
+
+    # analytic grads exist, are finite, and flow to both heads
+    import jax
+    import jax.numpy as jnp
+
+    def f(loc_, conf_):
+        outs = get_op_def("ssd_loss").compute(
+            {"Location": [loc_], "Confidence": [conf_], "GtBox": [gt],
+             "GtLabel": [label], "PriorBox": [prior]},
+            {"neg_pos_ratio": 3.0})
+        return jnp.sum(outs["Loss"][0])
+
+    gl, gc = jax.grad(f, argnums=(0, 1))(jnp.asarray(loc), jnp.asarray(conf))
+    assert np.isfinite(np.asarray(gl)).all() and np.any(np.asarray(gl) != 0)
+    assert np.isfinite(np.asarray(gc)).all() and np.any(np.asarray(gc) != 0)
+
+
+def test_yolov3_loss_matches_structure():
+    n, hgrid, c = 1, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    x = _r((n, len(mask) * (5 + c), hgrid, hgrid), 8) - 0.5
+    gt = np.zeros((n, 2, 4), np.float32)
+    gt[0, 0] = [0.5, 0.5, 0.3, 0.4]   # one valid center-format box
+    lbl = np.array([[1, 0]], np.int64)
+    outs = get_op_def("yolov3_loss").compute(
+        {"X": [x], "GTBox": [gt], "GTLabel": [lbl]},
+        {"anchors": anchors, "anchor_mask": mask, "class_num": c,
+         "ignore_thresh": 0.7, "downsample_ratio": 32})
+    loss = np.asarray(outs["Loss"][0])
+    obj = np.asarray(outs["ObjectnessMask"][0])
+    gmm = np.asarray(outs["GTMatchMask"][0])
+    assert loss.shape == (n,) and np.isfinite(loss).all() and loss[0] > 0
+    assert gmm[0, 0] >= 0 and gmm[0, 1] == -1      # padding row unmatched
+    assert np.any(obj > 0)                          # a positive cell
+
+    # analytic grad flows to X and is finite
+    import jax
+    import jax.numpy as jnp
+
+    def f(x_):
+        o = get_op_def("yolov3_loss").compute(
+            {"X": [x_], "GTBox": [gt], "GTLabel": [lbl]},
+            {"anchors": anchors, "anchor_mask": mask, "class_num": c,
+             "ignore_thresh": 0.7, "downsample_ratio": 32})
+        return jnp.sum(o["Loss"][0])
+
+    gx = np.asarray(jax.grad(f)(jnp.asarray(x)))
+    assert np.isfinite(gx).all() and np.any(gx != 0)
+
+
+def test_rpn_target_assign_dense():
+    anchors = _boxes(1, 32, 9, size=50.0)[0]
+    gt = _boxes(2, 4, 10, size=50.0)
+    gt[:, -1] = 0.0
+    im_info = np.tile(np.array([[60.0, 60.0, 1.0]], np.float32), (2, 1))
+    outs = get_op_def("rpn_target_assign").compute(
+        {"Anchor": [anchors], "GtBoxes": [gt], "ImInfo": [im_info]},
+        {"rpn_batch_size_per_im": 16, "rpn_straddle_thresh": -1.0,
+         "use_random": False})
+    label = np.asarray(outs["ScoreLabel"][0])
+    sw = np.asarray(outs["ScoreWeight"][0])
+    bw = np.asarray(outs["BboxWeight"][0])
+    assert label.shape == (2, 32)
+    assert np.all((sw == 0) | (sw == 1))
+    assert np.sum(sw, 1).max() <= 16
+    # every gt has at least one positive anchor
+    assert np.all(np.sum(label == 1, axis=1) >= 1)
+    assert np.all(bw[label != 1] == 0)
+
+
+def test_generate_proposals_shapes():
+    n, a, hh, ww = 2, 3, 4, 4
+    scores = _r((n, a, hh, ww), 11)
+    deltas = _r((n, 4 * a, hh, ww), 12, 0.1) - 0.05
+    im_info = np.tile(np.array([[64.0, 64.0, 1.0]], np.float32), (n, 1))
+    anchors = _boxes(1, hh * ww * a, 13, size=60.0)[0].reshape(hh, ww, a, 4)
+    var = np.full((hh, ww, a, 4), 1.0, np.float32)
+    outs = get_op_def("generate_proposals").compute(
+        {"Scores": [scores], "BboxDeltas": [deltas], "ImInfo": [im_info],
+         "Anchors": [anchors], "Variances": [var]},
+        {"pre_nms_topN": 24, "post_nms_topN": 8, "nms_thresh": 0.7,
+         "min_size": 2.0})
+    rois = np.asarray(outs["RpnRois"][0])
+    num = np.asarray(outs["RpnRoisNum"][0])
+    assert rois.shape == (n, 8, 4)
+    assert np.all(num >= 1) and np.all(num <= 8)
+    for i in range(n):
+        live = rois[i, :num[i]]
+        assert np.all(live[:, 2] >= live[:, 0])
+        assert np.all(rois[i, num[i]:] == 0)
+
+
+def test_generate_proposal_labels_sampling():
+    rois = _boxes(2, 20, 14, size=50.0)
+    gt = _boxes(2, 3, 15, size=50.0)
+    gt_cls = np.random.RandomState(16).randint(1, 5, (2, 3)).astype(np.int32)
+    im_info = np.tile(np.array([[60.0, 60.0, 1.0]], np.float32), (2, 1))
+    outs = get_op_def("generate_proposal_labels").compute(
+        {"RpnRois": [rois], "GtClasses": [gt_cls], "GtBoxes": [gt],
+         "ImInfo": [im_info]},
+        {"batch_size_per_im": 8, "fg_fraction": 0.5, "fg_thresh": 0.5,
+         "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": 5,
+         "use_random": False})
+    labels = np.asarray(outs["LabelsInt32"][0])
+    tgt = np.asarray(outs["BboxTargets"][0])
+    win = np.asarray(outs["BboxInsideWeights"][0])
+    assert labels.shape == (2, 8) and tgt.shape == (2, 8, 20)
+    # fg rows get exactly one class's 4 columns of weight
+    fg = labels > 0
+    assert np.all(win[fg].sum(-1) == 4.0)
+    assert np.all(win[~fg] == 0.0)
+
+
+def test_distribute_and_collect_fpn():
+    rois = np.zeros((1, 6, 4), np.float32)
+    sizes = [16, 32, 90, 200, 300, 0]   # last row padding
+    for j, s in enumerate(sizes):
+        rois[0, j] = [10, 10, 10 + s, 10 + s]
+    outs = get_op_def("distribute_fpn_proposals").compute(
+        {"FpnRois": [rois]},
+        {"min_level": 2, "max_level": 5, "refer_level": 4,
+         "refer_scale": 224})
+    multi = [np.asarray(x) for x in outs["MultiFpnRois"]]
+    nums = [np.asarray(x) for x in outs["MultiLevelRoIsNum"]]
+    restore = np.asarray(outs["RestoreInd"][0])
+    assert sum(int(x[0]) for x in nums) == 5
+    # small rois land on the lowest level
+    assert nums[0][0] >= 1 and multi[0][0, 0, 2] <= 50
+    assert restore[0, -1] == -1       # padding row
+    concat = np.concatenate(multi, 1)[0]
+    for j in range(5):
+        np.testing.assert_allclose(concat[restore[0, j]], rois[0, j])
+
+    scores = [np.linspace(0.9, 0.1, multi[i].shape[1],
+                          dtype=np.float32)[None] for i in range(4)]
+    out2 = get_op_def("collect_fpn_proposals").compute(
+        {"MultiLevelRois": multi, "MultiLevelScores": scores},
+        {"post_nms_topN": 4})
+    fpn = np.asarray(out2["FpnRois"][0])
+    num = np.asarray(out2["RoisNum"][0])
+    assert fpn.shape == (1, 4, 4) and num[0] == 4
+
+
+def test_box_decoder_and_assign():
+    p, c = 6, 3
+    prior = _boxes(1, p, 17, size=50.0)[0]
+    pvar = np.full((4,), 0.1, np.float32)
+    target = _r((p, 4 * c), 18, 0.2) - 0.1
+    score = _r((p, c), 19)
+    outs = get_op_def("box_decoder_and_assign").compute(
+        {"PriorBox": [prior], "PriorBoxVar": [pvar], "TargetBox": [target],
+         "BoxScore": [score]}, {"box_clip": 4.135})
+    dec = np.asarray(outs["DecodeBox"][0])
+    assign = np.asarray(outs["OutputAssignBox"][0])
+    assert dec.shape == (p, 4 * c) and assign.shape == (p, 4)
+    best = score.argmax(1)
+    for i in range(p):
+        np.testing.assert_allclose(assign[i],
+                                   dec[i, best[i] * 4:(best[i] + 1) * 4],
+                                   rtol=1e-5)
+
+
+def test_detection_map_perfect_and_miss():
+    # one class, one gt, one perfect detection -> mAP 1
+    det = np.array([[[0, 0.9, 10, 10, 20, 20]]], np.float32)
+    gt = np.array([[[0, 10, 10, 20, 20]]], np.float32)
+    outs = get_op_def("detection_map").compute(
+        {"DetectRes": [det], "Label": [gt]}, {"class_num": 1})
+    assert np.asarray(outs["MAP"][0]) == pytest.approx(1.0, abs=1e-5)
+    # detection misses -> mAP 0
+    det2 = np.array([[[0, 0.9, 40, 40, 50, 50]]], np.float32)
+    outs2 = get_op_def("detection_map").compute(
+        {"DetectRes": [det2], "Label": [gt]}, {"class_num": 1})
+    assert np.asarray(outs2["MAP"][0]) == pytest.approx(0.0, abs=1e-5)
+
+
+def test_detection_layers_build():
+    """The layer API builds a program end to end (shapes/attrs wiring)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = layers.data("feat", shape=[8, 8, 8], dtype="float32")
+        img = layers.data("img", shape=[3, 64, 64], dtype="float32")
+        boxes, var = layers.prior_box(feat, img, min_sizes=[16.0],
+                                      aspect_ratios=[1.0, 2.0], flip=True)
+        anchors, avar = layers.anchor_generator(feat,
+                                                anchor_sizes=[32.0, 64.0],
+                                                aspect_ratios=[1.0],
+                                                stride=[8.0, 8.0])
+        assert boxes.shape[-1] == 4 and anchors.shape[-1] == 4
+
+
+def test_ssd_model_trains():
+    from paddle_tpu.models import ssd
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        model = ssd.get_model(batch_size=8, num_classes=5, gt_capacity=4)
+        fluid.optimizer.Adam(2e-3).minimize(model["loss"])
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for s in range(25):
+            feed = ssd.synthetic_batch(8, num_classes=5, gt_capacity=4,
+                                       seed=s % 5)
+            out = exe.run(main, feed=feed, fetch_list=[model["loss"]])
+            losses.append(float(out[0]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.2, losses
+
+
+def test_ssd_detection_output_shape():
+    from paddle_tpu.models import ssd
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        model = ssd.get_model(batch_size=2, num_classes=5, gt_capacity=4)
+    exe = fluid.Executor()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = ssd.synthetic_batch(2, num_classes=5, gt_capacity=4)
+        det = exe.run(main, feed=feed, fetch_list=[model["detection"]])[0]
+    assert det.shape[0] == 2 and det.shape[2] == 6
