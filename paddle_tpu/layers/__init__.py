@@ -7,6 +7,8 @@ from paddle_tpu.layers.rnn import *  # noqa: F401,F403
 from paddle_tpu.layers import detection  # noqa: F401
 from paddle_tpu.layers.detection import *  # noqa: F401,F403
 from paddle_tpu.layers.control_flow import (  # noqa: F401
+    DynamicRNN,
+    IfElse,
     StaticRNN,
     Switch,
     While,

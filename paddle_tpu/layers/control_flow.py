@@ -29,12 +29,15 @@ from paddle_tpu.framework import (
     default_main_program,
 )
 from paddle_tpu.layer_helper import LayerHelper
+from paddle_tpu.layers.tensor import range_
 
 __all__ = [
     "While",
     "while_loop",
     "cond",
     "StaticRNN",
+    "DynamicRNN",
+    "IfElse",
     "Switch",
     "increment",
     "array_fill",
@@ -331,9 +334,24 @@ class StaticRNN:
             cur = self._program.current_block_idx
             self._program.current_block_idx = self._parent.idx
             try:
-                init = layers.fill_constant(
-                    shape=list(shape), dtype=dtype, value=init_value
-                )
+                if batch_ref is not None:
+                    # leading dim copied from batch_ref's dim
+                    # init_batch_dim_idx (reference StaticRNN.memory)
+                    helper = LayerHelper("rnn_mem_init")
+                    init = helper.create_variable_for_type_inference(
+                        dtype=dtype)
+                    helper.append_op(
+                        "fill_constant_batch_size_like",
+                        inputs={"Input": batch_ref},
+                        outputs={"Out": init},
+                        attrs={"shape": [-1] + list(shape),
+                               "value": init_value, "dtype": dtype,
+                               "input_dim_idx": init_batch_dim_idx,
+                               "output_dim_idx": 0})
+                else:
+                    init = layers.fill_constant(
+                        shape=list(shape), dtype=dtype, value=init_value
+                    )
             finally:
                 self._program.current_block_idx = cur
         pre = self._sub.create_var(
@@ -558,3 +576,226 @@ def array_write_step(array: Variable, index: Variable, value: Variable):
     )
     out.shape = array.shape
     return out
+
+
+class DynamicRNN:
+    """Batch RNN over padded sequences (reference:
+    layers/control_flow.py:1661 ``DynamicRNN``).
+
+    The reference unfolds LoD sequences through a While loop with rank
+    tables shrinking the batch as sequences end. The TPU-native design
+    keeps the batch DENSE and static: inputs are padded [B, T, ...]
+    tensors with an optional per-sample ``length`` [B] (the SURVEY.md
+    section 5 padding design); the recurrence lowers to the same
+    differentiable ``scan`` op as StaticRNN, and masking replaces the
+    shrinking batch — memories freeze (carry their last valid value) and
+    outputs are zeroed once ``t >= length``.
+
+    Usage::
+
+        drnn = DynamicRNN()
+        with drnn.block():
+            w = drnn.step_input(emb, length=seq_len)   # emb [B, T, D]
+            prev = drnn.memory(shape=[200])
+            h = layers.fc([w, prev], 200, act="relu")
+            drnn.update_memory(prev, h)
+            drnn.output(h)
+        out = drnn()                      # [B, T, 200], zero past length
+        last = layers.sequence_pool(out, "last", length=seq_len)
+    """
+
+    def __init__(self, name=None):
+        self._rnn = StaticRNN(name)
+        self._length: Optional[Variable] = None
+        self._keep: Optional[Variable] = None   # [B, 1] bool in-block
+        self._batch_ref: Optional[Variable] = None
+        self._in_block = False
+
+    @contextlib.contextmanager
+    def block(self):
+        with self._rnn.step():
+            self._in_block = True
+            try:
+                yield
+            finally:
+                self._in_block = False
+
+    def _ensure_keep(self):
+        """Lazy [B, 1] bool keep mask = (t < length), built once per
+        block."""
+        from paddle_tpu import layers
+
+        if self._keep is not None or self._length is None:
+            return
+        prog = self._rnn._program
+        t = self._rnn._seq_len
+        cur = prog.current_block_idx
+        prog.current_block_idx = self._rnn._parent.idx
+        try:
+            steps = layers.reshape(range_(0, t, 1, "int64"), [1, t, 1])
+        finally:
+            prog.current_block_idx = cur
+        t_step = self._rnn.step_input(steps)          # [1, 1] int64
+        # normalize length to [B, 1] whatever its declared/fed rank
+        length = layers.reshape(self._length, [-1, 1])
+        self._keep = layers.less_than(
+            t_step, layers.cast(length, "int64"))      # [B, 1] bool
+
+    def _keep_as(self, v: Variable):
+        """The keep mask reshaped to broadcast against rank(v): [B] for
+        rank-1 values, [B, 1, ...] for higher ranks (a bare [B, 1] mask
+        against a [B] value would outer-broadcast to [B, B])."""
+        from paddle_tpu import layers
+
+        rank = len(v.shape or ())
+        if rank == 1:
+            return layers.reshape(self._keep, [-1])
+        if rank > 2:
+            return layers.reshape(self._keep, [-1, 1] + [1] * (rank - 2))
+        return self._keep
+
+    def _require_block(self, what):
+        if not self._in_block:
+            raise ValueError(
+                f"DynamicRNN.{what}() must be called inside "
+                "`with drnn.block():` (reference DynamicRNN._assert_in_rnn_"
+                "block_ semantics)")
+
+    def step_input(self, x: Variable, level=0, length: Optional[Variable] = None):
+        self._require_block("step_input")
+        step = self._rnn.step_input(x)
+        if self._batch_ref is None:
+            self._batch_ref = x
+        if length is not None:
+            if self._length is not None and length.name != self._length.name:
+                raise ValueError(
+                    "DynamicRNN: conflicting `length` on a second "
+                    f"step_input ('{self._length.name}' vs '{length.name}')"
+                    " — all scanned inputs share one length tensor")
+            self._length = length
+        return step
+
+    def static_input(self, x: Variable) -> Variable:
+        """Non-scanned input, visible at every step (reference
+        drnn.static_input; dense: captured as-is)."""
+        return x
+
+    def memory(self, init: Optional[Variable] = None, shape=None,
+               value: float = 0.0, need_reorder: bool = False,
+               dtype="float32"):
+        self._require_block("memory")
+        if init is not None:
+            return self._rnn.memory(init=init)
+        if shape is None:
+            raise ValueError("DynamicRNN.memory needs init= or shape=")
+        if self._batch_ref is None:
+            raise ValueError(
+                "DynamicRNN.memory(shape=...) must follow step_input so "
+                "the batch size is known")
+        return self._rnn.memory(shape=list(shape),
+                                batch_ref=self._batch_ref,
+                                init_batch_dim_idx=0,
+                                init_value=value, dtype=dtype)
+
+    def update_memory(self, mem: Variable, new: Variable):
+        from paddle_tpu import layers
+
+        self._require_block("update_memory")
+        self._ensure_keep()
+        if self._keep is not None:
+            # freeze finished rows: carry keeps its last valid value
+            new = layers.where(self._keep_as(new), new, mem)
+        self._rnn.update_memory(mem, new)
+
+    def output(self, *outputs):
+        from paddle_tpu import layers
+
+        self._require_block("output")
+        self._ensure_keep()
+        for o in outputs:
+            if self._keep is not None:
+                o = layers.where(self._keep_as(o), o,
+                                 layers.fill_constant_like(o, 0))
+            self._rnn.step_output(o)
+
+    def __call__(self):
+        return self._rnn()
+
+
+class IfElse:
+    """Per-sample two-way branch (reference:
+    layers/control_flow.py:1525 ``IfElse``).
+
+    The reference gathers the true/false row subsets into separate
+    sub-blocks and scatters results back (dynamic row counts). The
+    TPU-native design computes BOTH branches over the full dense batch
+    and merges rows with a select — static shapes, XLA-fusable, same
+    results for the row-wise computations the construct exists for (the
+    branches cost compute for all rows; on the MXU that is cheaper than
+    dynamic-shape gathers).
+
+    Usage::
+
+        ie = IfElse(cond)                  # cond [B, 1] bool
+        with ie.true_block():
+            ie.output(fc_true(ie.input(x)))
+        with ie.false_block():
+            ie.output(fc_false(ie.input(x)))
+        out = ie()
+    """
+
+    def __init__(self, cond: Variable, name=None):
+        self._cond = cond
+        self._true_outs: List[Variable] = []
+        self._false_outs: List[Variable] = []
+        self._phase: Optional[str] = None
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._phase = "true"
+        try:
+            yield
+        finally:
+            self._phase = None
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._phase = "false"
+        try:
+            yield
+        finally:
+            self._phase = None
+
+    def input(self, x: Variable) -> Variable:
+        if self._phase is None:
+            raise ValueError("IfElse.input() outside true_block/false_block")
+        return x
+
+    def output(self, *outs):
+        if self._phase == "true":
+            self._true_outs.extend(outs)
+        elif self._phase == "false":
+            self._false_outs.extend(outs)
+        else:
+            raise ValueError(
+                "IfElse.output() outside true_block/false_block")
+
+    def __call__(self):
+        from paddle_tpu import layers
+
+        if len(self._true_outs) != len(self._false_outs):
+            raise ValueError(
+                f"IfElse branches produced {len(self._true_outs)} vs "
+                f"{len(self._false_outs)} outputs; they must align")
+        merged = []
+        for t, f in zip(self._true_outs, self._false_outs):
+            rank = len(t.shape or ())
+            cond = self._cond
+            # reshape cond to broadcast per ROW whatever the output rank
+            # (a [B, 1] cond against a [B] output would outer-broadcast)
+            if rank == 1:
+                cond = layers.reshape(cond, [-1])
+            elif rank > 2:
+                cond = layers.reshape(cond, [-1, 1] + [1] * (rank - 2))
+            merged.append(layers.where(cond, t, f))
+        return merged[0] if len(merged) == 1 else merged

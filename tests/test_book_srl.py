@@ -1,0 +1,160 @@
+"""Book ch07 label_semantic_roles + DynamicRNN/IfElse layers.
+
+Reference: python/paddle/fluid/tests/book/test_label_semantic_roles.py
+(CRF-based semantic role labelling trained end to end, then a
+save/load_inference_model round-trip) and layers/control_flow.py
+DynamicRNN:1661 / IfElse:1525.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import io, layers
+
+WORD_DICT, LABEL_DICT = 64, 8
+SEQ, BATCH = 12, 16
+EMB, HID = 24, 32
+
+
+def _srl_batch(seed):
+    """Synthetic SRL data with a learnable rule: the label of a word
+    depends on its id bucket and whether the predicate is nearby."""
+    r = np.random.RandomState(seed)
+    words = r.randint(1, WORD_DICT, (BATCH, SEQ)).astype(np.int64)
+    pred = r.randint(0, SEQ, (BATCH,))
+    mark = np.zeros((BATCH, SEQ), np.int64)
+    for i, p in enumerate(pred):
+        mark[i, p] = 1
+    length = r.randint(SEQ // 2, SEQ + 1, (BATCH,)).astype(np.int64)
+    labels = ((words % 4) + 4 * mark) % LABEL_DICT
+    for i in range(BATCH):
+        labels[i, length[i]:] = 0
+        words[i, length[i]:] = 0
+    return {"word": words, "mark": mark, "label": labels,
+            "length": length}
+
+
+def _build_srl():
+    word = layers.data("word", shape=[SEQ], dtype="int64")
+    mark = layers.data("mark", shape=[SEQ], dtype="int64")
+    label = layers.data("label", shape=[SEQ], dtype="int64")
+    length = layers.data("length", shape=[1], dtype="int64")
+
+    word_emb = layers.embedding(word, size=[WORD_DICT, EMB])
+    mark_emb = layers.embedding(mark, size=[2, EMB // 2])
+    feat = layers.concat([word_emb, mark_emb], axis=-1)  # [B, T, E]
+
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        w = drnn.step_input(feat, length=length)
+        prev = drnn.memory(shape=[HID])
+        h = layers.fc([w, prev], HID, act="tanh")
+        drnn.update_memory(prev, h)
+        drnn.output(h)
+    hidden = drnn()                                      # [B, T, HID]
+
+    emission = layers.fc(hidden, LABEL_DICT, num_flatten_dims=2)
+    crf_cost = layers.linear_chain_crf(
+        emission, label, param_attr=fluid.ParamAttr(name="crfw"),
+        length=length)
+    avg_cost = layers.mean(crf_cost)
+    decode = layers.crf_decoding(
+        emission, param_attr=fluid.ParamAttr(name="crfw"), length=length)
+    return word, mark, label, length, emission, avg_cost, decode
+
+
+def test_book_label_semantic_roles(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        (word, mark, label, length, emission, avg_cost,
+         decode) = _build_srl()
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(5e-3).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for step in range(120):
+            out = exe.run(main, feed=_srl_batch(step % 8),
+                          fetch_list=[avg_cost])
+            losses.append(float(out[0]))
+        assert np.isfinite(losses).all()
+        # converges like the reference's train loop (cost drops hard)
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.6, \
+            losses[::20]
+
+        # save/load_inference_model round-trip on the decode path
+        d = str(tmp_path / "srl_model")
+        io.save_inference_model(
+            d, ["word", "mark", "length"], [emission, decode], exe, main)
+        fd = _srl_batch(3)
+        ref_em, ref_path = exe.run(
+            test_prog, feed=fd, fetch_list=[emission, decode])
+
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog2, feed_names, fetch_vars = io.load_inference_model(d, exe2)
+        got_em, got_path = exe2.run(
+            prog2,
+            feed={k: fd[k] for k in ("word", "mark", "length")},
+            fetch_list=fetch_vars)
+    np.testing.assert_allclose(ref_em, got_em, rtol=1e-4, atol=1e-5)
+    np.testing.assert_array_equal(ref_path, got_path)
+
+
+def test_dynamic_rnn_masks_and_freezes():
+    """Memories freeze and outputs zero past each sample's length."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[5, 3], dtype="float32")
+        length = layers.data("length", shape=[1], dtype="int64")
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x, length=length)
+            acc = drnn.memory(shape=[3])
+            new = layers.elementwise_add(acc, xt)
+            drnn.update_memory(acc, new)
+            drnn.output(new)
+        out = drnn()
+        last = layers.sequence_pool(out, "last", length=length)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xv = np.ones((2, 5, 3), np.float32)
+    lv = np.array([[2], [4]], np.int64)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        o, l = exe.run(main, feed={"x": xv, "length": lv},
+                       fetch_list=[out, last])
+    # running sums up to the length, zeros after
+    np.testing.assert_allclose(o[0, :2, 0], [1, 2])
+    np.testing.assert_allclose(o[0, 2:, 0], [0, 0, 0])
+    np.testing.assert_allclose(o[1, :4, 0], [1, 2, 3, 4])
+    np.testing.assert_allclose(l[:, 0], [2, 4])
+
+
+def test_if_else_merges_rows():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        limit = layers.data("limit", shape=[1], dtype="float32")
+        row_sum = layers.reduce_sum(x, dim=1, keep_dim=True)
+        cond = layers.less_than(row_sum, limit)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(layers.scale(ie.input(x), 2.0))
+        with ie.false_block():
+            ie.output(layers.scale(ie.input(x), -1.0))
+        out = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xv = np.array([[1, 1, 1, 1], [9, 9, 9, 9]], np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        o = exe.run(main, feed={"x": xv,
+                                "limit": np.full((2, 1), 10.0, np.float32)},
+                    fetch_list=[out])[0]
+    np.testing.assert_allclose(o[0], xv[0] * 2.0)   # sum 4 < 10 -> true
+    np.testing.assert_allclose(o[1], xv[1] * -1.0)  # sum 36 >= 10 -> false
