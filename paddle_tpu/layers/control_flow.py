@@ -45,6 +45,20 @@ __all__ = [
 ]
 
 
+def _broadcast_row_mask(mask: Variable, v: Variable) -> Variable:
+    """Reshape a [B, 1] per-row mask to broadcast against rank(v): [B]
+    for rank-1 values, [B, 1, ...] for higher ranks (a bare [B, 1] mask
+    against a [B] value would outer-broadcast to [B, B])."""
+    from paddle_tpu import layers
+
+    rank = len(v.shape or ())
+    if rank == 1:
+        return layers.reshape(mask, [-1])
+    if rank > 2:
+        return layers.reshape(mask, [-1, 1] + [1] * (rank - 2))
+    return mask
+
+
 def _ordered_unique(names):
     seen = set()
     out = []
@@ -335,8 +349,9 @@ class StaticRNN:
             self._program.current_block_idx = self._parent.idx
             try:
                 if batch_ref is not None:
-                    # leading dim copied from batch_ref's dim
-                    # init_batch_dim_idx (reference StaticRNN.memory)
+                    # batch dim read from batch_ref.shape[ref_batch_dim_idx]
+                    # and written at init_batch_dim_idx (reference
+                    # StaticRNN.memory fill_constant_batch_size_like)
                     helper = LayerHelper("rnn_mem_init")
                     init = helper.create_variable_for_type_inference(
                         dtype=dtype)
@@ -346,8 +361,8 @@ class StaticRNN:
                         outputs={"Out": init},
                         attrs={"shape": [-1] + list(shape),
                                "value": init_value, "dtype": dtype,
-                               "input_dim_idx": init_batch_dim_idx,
-                               "output_dim_idx": 0})
+                               "input_dim_idx": ref_batch_dim_idx,
+                               "output_dim_idx": init_batch_dim_idx})
                 else:
                     init = layers.fill_constant(
                         shape=list(shape), dtype=dtype, value=init_value
@@ -642,17 +657,7 @@ class DynamicRNN:
             t_step, layers.cast(length, "int64"))      # [B, 1] bool
 
     def _keep_as(self, v: Variable):
-        """The keep mask reshaped to broadcast against rank(v): [B] for
-        rank-1 values, [B, 1, ...] for higher ranks (a bare [B, 1] mask
-        against a [B] value would outer-broadcast to [B, B])."""
-        from paddle_tpu import layers
-
-        rank = len(v.shape or ())
-        if rank == 1:
-            return layers.reshape(self._keep, [-1])
-        if rank > 2:
-            return layers.reshape(self._keep, [-1, 1] + [1] * (rank - 2))
-        return self._keep
+        return _broadcast_row_mask(self._keep, v)
 
     def _require_block(self, what):
         if not self._in_block:
@@ -694,7 +699,7 @@ class DynamicRNN:
                 "the batch size is known")
         return self._rnn.memory(shape=list(shape),
                                 batch_ref=self._batch_ref,
-                                init_batch_dim_idx=0,
+                                init_batch_dim_idx=0, ref_batch_dim_idx=0,
                                 init_value=value, dtype=dtype)
 
     def update_memory(self, mem: Variable, new: Variable):
@@ -789,13 +794,6 @@ class IfElse:
                 f"{len(self._false_outs)} outputs; they must align")
         merged = []
         for t, f in zip(self._true_outs, self._false_outs):
-            rank = len(t.shape or ())
-            cond = self._cond
-            # reshape cond to broadcast per ROW whatever the output rank
-            # (a [B, 1] cond against a [B] output would outer-broadcast)
-            if rank == 1:
-                cond = layers.reshape(cond, [-1])
-            elif rank > 2:
-                cond = layers.reshape(cond, [-1, 1] + [1] * (rank - 2))
-            merged.append(layers.where(cond, t, f))
+            merged.append(
+                layers.where(_broadcast_row_mask(self._cond, t), t, f))
         return merged[0] if len(merged) == 1 else merged

@@ -190,3 +190,41 @@ def _decayed_adagrad(ins, attrs):
     g = g.astype(p.dtype)
     m_new = decay * m + (1 - decay) * jnp.square(g)
     return {"ParamOut": [p - lr * g / (jnp.sqrt(m_new) + eps)], "MomentOut": [m_new]}
+
+
+@register_op("adamax", no_grad=True)
+def _adamax(ins, attrs):
+    """Adamax: Adam with an infinity-norm second moment (reference:
+    operators/optimizers/adamax_op.cc; optimizer.py AdamaxOptimizer)."""
+    p, g = _g(ins, "Param"), _g(ins, "Grad")
+    m, u = _g(ins, "Moment"), _g(ins, "InfNorm")
+    b1p = _g(ins, "Beta1Pow")
+    lr = _g(ins, "LearningRate").reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    g = g.astype(m.dtype)
+    m_new = b1 * m + (1 - b1) * g
+    u_new = jnp.maximum(b2 * u, jnp.abs(g))
+    b1pn = b1p * b1
+    lr_t = (lr / (1 - b1pn.reshape(()))).astype(p.dtype)
+    p_new = p - lr_t * (m_new / (u_new + eps)).astype(p.dtype)
+    return {"ParamOut": [p_new], "MomentOut": [m_new],
+            "InfNormOut": [u_new], "Beta1PowOut": [b1pn]}
+
+
+@register_op("adadelta", no_grad=True)
+def _adadelta(ins, attrs):
+    """Adadelta (reference: operators/optimizers/adadelta_op.cc): the
+    classic learning-rate-free update from accumulated squared grads and
+    squared updates."""
+    p, g = _g(ins, "Param"), _g(ins, "Grad")
+    eg2, edx2 = _g(ins, "AvgSquaredGrad"), _g(ins, "AvgSquaredUpdate")
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    g = g.astype(p.dtype)
+    eg2_new = rho * eg2 + (1 - rho) * jnp.square(g)
+    upd = -jnp.sqrt((edx2 + eps) / (eg2_new + eps)) * g
+    edx2_new = rho * edx2 + (1 - rho) * jnp.square(upd)
+    return {"ParamOut": [p + upd], "AvgSquaredGradOut": [eg2_new],
+            "AvgSquaredUpdateOut": [edx2_new]}

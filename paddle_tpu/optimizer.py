@@ -560,12 +560,73 @@ class FtrlOptimizer(Optimizer):
         )
 
 
+class AdamaxOptimizer(Optimizer):
+    """Adamax (reference: optimizer.py:41 'Adamax', AdamaxOptimizer)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow", p, fill_value=1.0, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        u = self._get_accumulator("inf_norm", p)
+        b1p = self._get_accumulator("beta1_pow", p)
+        block.append_op(
+            "adamax",
+            inputs={"Param": p, "Grad": g, "Moment": m, "InfNorm": u,
+                    "Beta1Pow": b1p, "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name, "MomentOut": m.name,
+                     "InfNormOut": u.name, "Beta1PowOut": b1p.name},
+            attrs={"beta1": self._beta1, "beta2": self._beta2,
+                   "epsilon": self._epsilon},
+        )
+
+
+class AdadeltaOptimizer(Optimizer):
+    """Adadelta (reference: optimizer.py:41 'Adadelta'); the op applies
+    the classic learning-rate-free rule, matching the reference kernel."""
+
+    def __init__(self, learning_rate=1.0, epsilon=1e-6, rho=0.95,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        eg2 = self._get_accumulator("avg_squared_grad", p)
+        edx2 = self._get_accumulator("avg_squared_update", p)
+        block.append_op(
+            "adadelta",
+            inputs={"Param": p, "Grad": g, "AvgSquaredGrad": eg2,
+                    "AvgSquaredUpdate": edx2,
+                    "LearningRate": self._param_lr(p)},
+            outputs={"ParamOut": p.name, "AvgSquaredGradOut": eg2.name,
+                     "AvgSquaredUpdateOut": edx2.name},
+            attrs={"rho": self._rho, "epsilon": self._epsilon},
+        )
+
+
 # Short aliases matching the reference's public names.
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adam = AdamOptimizer
 AdamW = AdamWOptimizer
 Adagrad = AdagradOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
 DecayedAdagrad = DecayedAdagradOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer

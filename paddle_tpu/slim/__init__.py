@@ -1,6 +1,14 @@
 """Model compression (reference: python/paddle/fluid/contrib/slim/)."""
 
 from paddle_tpu.slim.distill import soft_label_distill_loss  # noqa: F401
+from paddle_tpu.slim.prune import (  # noqa: F401
+    SensitivePruneStrategy,
+    StructurePruner,
+    UniformPruneStrategy,
+    apply_masks,
+    compute_masks,
+    pruned_ratio,
+)
 from paddle_tpu.slim.quantization import (  # noqa: F401
     QuantizationTransformPass,
     dequantize_weights,

@@ -119,3 +119,89 @@ def test_distillation_loss_trains_student_toward_teacher():
             losses.append(float(
                 exe.run(main, feed=fd, fetch_list=[dloss])[0]))
     assert losses[-1] < losses[0] * 0.35  # student matches teacher dist
+
+
+def test_optimizers_adamax_adadelta():
+    """New optimizer tails converge on a quadratic (reference:
+    optimizer.py:41-47 Adamax/Adadelta)."""
+    for opt_cls, kwargs in [
+        (fluid.optimizer.Adamax, {"learning_rate": 0.05}),
+        (fluid.optimizer.Adadelta, {"learning_rate": 1.0, "rho": 0.9}),
+    ]:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[4], dtype="float32")
+            y = layers.fc(x, 1, bias_attr=False)
+            loss = layers.mean(layers.square(y))
+            opt_cls(**kwargs).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        xv = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            losses = [float(exe.run(main, feed={"x": xv},
+                                    fetch_list=[loss])[0])
+                      for _ in range(150)]
+        assert losses[-1] < losses[0] * 0.4, (opt_cls.__name__, losses[::30])
+
+
+def test_structured_pruning_uniform():
+    from paddle_tpu import slim
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[3, 8, 8], dtype="float32")
+        x = layers.conv2d(img, 8, 3, padding=1,
+                          param_attr=fluid.ParamAttr(name="conv1_weights"))
+        x = layers.conv2d(x, 8, 3, padding=1,
+                          param_attr=fluid.ParamAttr(name="conv2_weights"))
+        loss = layers.mean(x)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"img": np.random.RandomState(1).randn(2, 3, 8, 8).astype(
+        np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        strat = slim.UniformPruneStrategy(target_ratio=0.5,
+                                          pruned_params="conv.*_weights")
+        strat.on_compression_begin(scope)
+        # half the output channels are zero
+        w = np.asarray(scope.find_var("conv1_weights"))
+        zero_ch = np.sum(np.abs(w.reshape(w.shape[0], -1)).sum(1) == 0)
+        assert zero_ch == 4
+        assert abs(slim.pruned_ratio(scope, strat.masks) - 0.5) < 1e-6
+        # pruned channels survive an optimizer step via on_batch_end
+        exe.run(main, feed=feed, fetch_list=[loss])
+        strat.on_batch_end(scope)
+        w2 = np.asarray(scope.find_var("conv1_weights"))
+        assert np.sum(np.abs(w2.reshape(w2.shape[0], -1)).sum(1) == 0) == 4
+
+
+def test_structured_pruning_sensitive():
+    from paddle_tpu import slim
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[6], dtype="float32")
+        h = layers.fc(x, 8, param_attr=fluid.ParamAttr(name="fc_weights"),
+                      act="relu")
+        out = layers.fc(h, 1)
+        loss = layers.mean(layers.square(out))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xv = np.random.RandomState(2).randn(16, 6).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+        def metric():
+            # higher-is-better metric: negative loss
+            return -float(exe.run(main, feed={"x": xv},
+                                  fetch_list=[loss])[0])
+
+        strat = slim.SensitivePruneStrategy(
+            delta_rate=0.25, target_ratio=0.5,
+            pruned_params="fc_weights", max_metric_loss=1e9)
+        ratios = strat.prune(scope, metric)
+        assert "fc_weights" in ratios and 0 < ratios["fc_weights"] <= 0.5
+        assert strat.sensitivities["fc_weights"]
