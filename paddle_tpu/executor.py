@@ -236,6 +236,58 @@ class Executor:
             fetches = [np.asarray(x) for x in fetches]
         return fetches
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Drive a Dataset (InMemory/Queue, dataset_api.py) through the
+        compiled train step (reference: executor.py:846
+        ``train_from_dataset``).
+
+        The reference spins thread-per-core device workers consuming a
+        C++ data-feed channel; here the host side is a DeviceLoader
+        prefetching ``thread``-deep onto the device while the step's XLA
+        program runs — the whole-program-compilation analog of the
+        Downpour/Hogwild entry point. Returns the number of steps run.
+        """
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        from paddle_tpu.reader.pipeline import DeviceLoader
+
+        fetch_list = list(fetch_list or [])
+        names = [f.name if isinstance(f, Variable) else str(f)
+                 for f in fetch_list]
+        info = list(fetch_info or names)
+        # thread=0 means "use the dataset's configured thread num"
+        # (reference train_from_dataset convention)
+        depth = int(thread or 0) or int(
+            getattr(dataset, "_thread_num", 0) or 0)
+        loader = DeviceLoader(
+            dataset.batch_reader(),
+            feed_names=list(getattr(dataset, "_use_var_names", []) or []),
+            depth=max(2, depth),
+        )
+        steps = 0
+        for feed in loader:
+            fetches = self.run(program, feed=feed, fetch_list=fetch_list,
+                               scope=scope)
+            steps += 1
+            if debug and fetch_list and steps % print_period == 0:
+                msg = ", ".join(
+                    f"{k}={np.asarray(v).ravel()[:4]}"
+                    for k, v in zip(info, fetches))
+                print(f"[train_from_dataset] step {steps}: {msg}")
+        return steps
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Inference twin of ``train_from_dataset`` (reference:
+        executor.py ``infer_from_dataset``): identical drive loop — the
+        program simply contains no optimizer ops."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     def close(self):
         self._cache.clear()
 

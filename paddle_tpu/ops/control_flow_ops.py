@@ -263,5 +263,6 @@ def _scan_as_gpipe(ctx, sub_ops, xs, init, cap_vals, cap_names, x_names,
         n_micro=ctx.pipe_micro,
         batch_streams=tuple(cap_vals[i] for i in stream_idx),
         with_micro_idx=True,
+        data_axis=ctx.data_axis,
     )
     return {"Y": [], "FinalState": [out]}

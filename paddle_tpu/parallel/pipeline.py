@@ -83,9 +83,16 @@ def _gpipe_local(params, x_micro, streams, *, fn: Callable, axis: str,
     zero = jnp.zeros(mb_shape, x_micro.dtype)
     out0 = jnp.zeros_like(x_micro)
     # carries become rank-varying inside the body; align the initial type
-    vary = tuple(jax.typeof(params_leaf).vma | {axis}
-                 for params_leaf in [jax.tree.leaves(params)[0]])[0]
-    zero, out0 = lax.pcast((zero, out0), tuple(vary), to="varying")
+    # to every manual axis in play (pipe from the params, plus the data
+    # axis when dp x pp compose in one shard_map)
+    vary = (set(jax.typeof(jax.tree.leaves(params)[0]).vma)
+            | set(jax.typeof(x_micro).vma) | {axis})
+
+    def _pcast_to(v):
+        missing = tuple(vary - set(jax.typeof(v).vma))
+        return lax.pcast(v, missing, to="varying") if missing else v
+
+    zero, out0 = _pcast_to(zero), _pcast_to(out0)
     (_, out), _ = lax.scan(tick, (zero, out0), jnp.arange(total))
     # only the last rank holds nonzero outputs; psum replicates them
     return lax.psum(out, axis)
@@ -100,6 +107,7 @@ def gpipe(
     n_micro: Optional[int] = None,
     batch_streams=(),
     with_micro_idx: bool = False,
+    data_axis: Optional[str] = None,
 ):
     """Run ``x`` through ``n_stages`` stages pipelined over ``pipe_axis``.
 
@@ -115,6 +123,11 @@ def gpipe(
     - ``with_micro_idx`` — pass the stage's current microbatch index as a
       ``micro_idx`` kwarg (stochastic stages fold it into their PRNG key
       so microbatches draw independent randomness).
+    - ``data_axis`` — compose dp x pp in ONE program: each data-rank
+      group pipelines ITS batch shard (the microbatch dim is sharded over
+      ``data_axis``; ppermute/psum stay scoped to the pipe axis, so the
+      schedules run independently per data shard and the gradient
+      all-reduce over data happens outside in GSPMD land).
     Returns [B, ...] outputs (replicated over the pipe axis).
     """
     n_stages = mesh.shape[pipe_axis]
@@ -122,6 +135,13 @@ def gpipe(
     n_micro = n_micro or n_stages
     if b % n_micro != 0:
         raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    if data_axis:
+        d = mesh.shape[data_axis]
+        if (b // n_micro) % d != 0:
+            raise ValueError(
+                f"dp x pp: microbatch size {b // n_micro} "
+                f"(batch {b} / n_micro {n_micro}) not divisible by the "
+                f"data axis '{data_axis}' ({d} ranks)")
     x_m = x.reshape((n_micro, b // n_micro) + x.shape[1:])
     streams_m = tuple(
         sv.reshape((n_micro, b // n_micro) + sv.shape[1:])
@@ -140,11 +160,12 @@ def gpipe(
             n_micro=n_micro, with_micro_idx=with_micro_idx
         )
 
+    mb_spec = P(None, data_axis) if data_axis else P()
     out = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(param_specs, P(), P()),
-        out_specs=P(),
+        in_specs=(param_specs, mb_spec, mb_spec),
+        out_specs=mb_spec,
     )(stage_params, x_m, streams_m)
     return out.reshape((b,) + x.shape[1:])
 

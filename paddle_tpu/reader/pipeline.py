@@ -32,6 +32,7 @@ class DeviceLoader:
     def __iter__(self):
         q: queue.Queue = queue.Queue(maxsize=self._depth)
         END = object()
+        failure = []
 
         def worker():
             try:
@@ -47,6 +48,8 @@ class DeviceLoader:
                             for k, v in zip(self._names, sample)
                         }
                     q.put(feed)
+            except BaseException as e:  # surface in the consumer, not the
+                failure.append(e)       # daemon thread's stderr
             finally:
                 q.put(END)
 
@@ -54,6 +57,10 @@ class DeviceLoader:
         while True:
             item = q.get()
             if item is END:
+                if failure:
+                    raise RuntimeError(
+                        "DeviceLoader reader thread failed"
+                    ) from failure[0]
                 return
             yield item
 

@@ -1,0 +1,54 @@
+"""One program composing dp x pp on one mesh, with loss parity vs the
+single-device run (VERDICT r2 item 9: stronger multichip correctness
+statement than separate per-axis compositions)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import parallel
+from paddle_tpu.models import transformer as T
+from paddle_tpu.parallel.strategy import pipeline_rules
+
+
+def _build(n_layer):
+    cfg = T.TransformerConfig(
+        src_vocab_size=200, trg_vocab_size=200, d_model=32, d_inner=64,
+        n_head=2, n_layer=n_layer, max_length=20, dropout=0.0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        model = T.build_scan(cfg)
+        fluid.optimizer.SGD(0.05).minimize(model["loss"])
+    return cfg, main, startup, model
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_dp2_pp4_single_program_parity():
+    n_layer = 4
+    losses = {}
+    for mode in ("single", "dp_pp"):
+        cfg, main, startup, model = _build(n_layer)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            if mode == "single":
+                prog = main
+            else:
+                mesh = parallel.create_mesh(
+                    {"data": 2, "pipe": 4}, devices=jax.devices()[:8])
+                strategy = parallel.DistributedStrategy(
+                    mesh, data_axis="data", rules=pipeline_rules("pipe"),
+                    pipe_axis="pipe", pipe_micro=2)
+                prog = fluid.CompiledProgram(main).with_strategy(strategy)
+            cur = []
+            for s in range(3):
+                fd = T.make_batch(cfg, batch=8, src_len=16, trg_len=16,
+                                  seed=s)
+                out = exe.run(prog, feed=fd, fetch_list=[model["loss"]])
+                cur.append(float(out[0]))
+            losses[mode] = cur
+    np.testing.assert_allclose(losses["single"], losses["dp_pp"],
+                               rtol=2e-4, atol=2e-4)

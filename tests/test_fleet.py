@@ -49,7 +49,8 @@ def _single_process_losses():
     return out
 
 
-def test_fleet_two_process_loss_parity():
+@pytest.mark.parametrize("n_workers", [2, 4])
+def test_fleet_multi_process_loss_parity(n_workers):
     from paddle_tpu import native
 
     if not native.available():
@@ -57,7 +58,7 @@ def test_fleet_two_process_loss_parity():
     port = _free_port()
     env_base = {
         **os.environ,
-        "PT_TRAINERS": "2",
+        "PT_TRAINERS": str(n_workers),
         "PT_COORD_ENDPOINT": f"127.0.0.1:{port}",
         "PT_JAX_COORD_ENDPOINT": f"127.0.0.1:{_free_port()}",
         # workers configure jax themselves; drop any pytest leakage
@@ -67,7 +68,7 @@ def test_fleet_two_process_loss_parity():
         ),
     }
     procs = []
-    for rank in range(2):
+    for rank in range(n_workers):
         env = {**env_base, "PT_TRAINER_ID": str(rank)}
         procs.append(subprocess.Popen(
             [sys.executable, os.path.join(HERE, "fleet_worker.py")],
@@ -83,9 +84,10 @@ def test_fleet_two_process_loss_parity():
         r = json.loads(line[-1][len("FLEET_RESULT "):])
         results[r["rank"]] = r["losses"]
 
-    assert set(results) == {0, 1}
-    # both workers fetch the same (global-mean) loss
-    np.testing.assert_allclose(results[0], results[1], rtol=1e-5)
+    assert set(results) == set(range(n_workers))
+    # every worker fetches the same (global-mean) loss
+    for r in range(1, n_workers):
+        np.testing.assert_allclose(results[0], results[r], rtol=1e-5)
     # and it matches the single-process run over the full global batch
     single = _single_process_losses()
     np.testing.assert_allclose(single, results[0], rtol=1e-4, atol=1e-5)
