@@ -150,38 +150,55 @@ def main():
     mfu_mean = (flops * steps / mean) / V5E_PEAK_BF16
     log(f"tokens/sec={tokens_per_sec:.0f}, analytic TFLOP/step={flops/1e12:.2f}, MFU={mfu:.3f}")
 
-    # ResNet-50 rides along as a second metric, in a FRESH process: two
-    # co-resident compiled programs contaminate each other's HBM/timing
-    # (see BASELINE.md methodology). Free this process's HBM first —
-    # donated state, staged feeds, compiled executables all pin device
-    # memory the child would otherwise share the chip with.
-    resnet = None
-    if os.environ.get("PT_BENCH_RESNET", "1") == "1":
+    # Secondary metrics ride along in FRESH processes: two co-resident
+    # compiled programs contaminate each other's HBM/timing (see
+    # BASELINE.md methodology). Free this process's HBM first — donated
+    # state, staged feeds, compiled executables all pin device memory
+    # the children would otherwise share the chip with.
+    def _rider(argv, env_extra):
         import subprocess
 
-        del feeds
-        fluid.executor.global_scope().clear()
-        exe.close()
-        jax.clear_caches()
         try:
-            out = subprocess.run(
-                [sys.executable,
-                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "bench_resnet.py")],
-                capture_output=True, text=True, timeout=900)
+            env = {**os.environ, "PT_BENCH_RESNET": "0",
+                   "PT_BENCH_LONGCTX": "0", **env_extra}
+            out = subprocess.run(argv, capture_output=True, text=True,
+                                 timeout=900, env=env)
             if out.returncode != 0:
-                log(f"resnet bench rc={out.returncode}, "
+                log(f"rider {argv[-1]} rc={out.returncode}, "
                     f"stderr tail: {out.stderr[-500:]}")
+            parsed = None
             for line in out.stdout.splitlines():
                 line = line.strip()
                 if line.startswith("{"):
                     try:
-                        resnet = json.loads(line)
+                        parsed = json.loads(line)
                     except ValueError:
                         pass  # non-JSON line that happens to start with {
-            log(f"resnet50: {resnet}")
-        except Exception as e:  # never let the rider kill the headline
-            log(f"resnet bench failed: {type(e).__name__}: {e}")
+            return parsed
+        except Exception as e:  # never let a rider kill the headline
+            log(f"rider bench failed: {type(e).__name__}: {e}")
+            return None
+
+    resnet = longctx = None
+    here = os.path.dirname(os.path.abspath(__file__))
+    want_resnet = os.environ.get("PT_BENCH_RESNET", "1") == "1"
+    want_longctx = os.environ.get("PT_BENCH_LONGCTX", "1") == "1"
+    if want_resnet or want_longctx:
+        del feeds
+        fluid.executor.global_scope().clear()
+        exe.close()
+        jax.clear_caches()
+    if want_resnet:
+        resnet = _rider(
+            [sys.executable, os.path.join(here, "bench_resnet.py")], {})
+        log(f"resnet50: {resnet}")
+    if want_longctx:
+        longctx = _rider(
+            [sys.executable, os.path.join(here, "bench.py")],
+            {"PT_BENCH_BATCH": "8", "PT_BENCH_SEQ": "1024"})
+        if longctx is not None:
+            longctx["metric"] = "transformer_longctx_t1024_tokens_per_sec"
+        log(f"long-context t=1024: {longctx}")
 
     print(json.dumps({
         "metric": "transformer_base_train_tokens_per_sec",
@@ -192,6 +209,7 @@ def main():
         "mfu_best": round(mfu, 4),
         "mfu_mean": round(mfu_mean, 4),
         "resnet50": resnet,
+        "long_context_t1024": longctx,
     }))
 
 

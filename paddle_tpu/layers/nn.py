@@ -37,6 +37,7 @@ __all__ = [
     "roi_align", "roi_pool", "lrn", "spp", "affine_grid", "multiclass_nms",
     "yolo_box", "sequence_conv", "add_position_encoding", "conv3d",
     "spectral_norm", "hsigmoid", "sample_logits",
+    "chunk_eval", "ctc_greedy_decoder",
 ]
 
 
@@ -1478,3 +1479,50 @@ def sample_logits(logits, label, num_samples, remove_accidental_hits=True,
         attrs={"num_samples": int(num_samples),
                "remove_accidental_hits": bool(remove_accidental_hits)})
     return outs["SampledLogits"], outs["SampledLabel"]
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk-level P/R/F1 for tagging (reference: layers/nn.py
+    chunk_eval). Returns (precision, recall, f1, n_infer, n_label,
+    n_correct)."""
+    helper = LayerHelper("chunk_eval")
+    outs = {}
+    for slot, dt in [("Precision", "float32"), ("Recall", "float32"),
+                     ("F1-Score", "float32"), ("NumInferChunks", "int64"),
+                     ("NumLabelChunks", "int64"),
+                     ("NumCorrectChunks", "int64")]:
+        outs[slot] = helper.create_variable_for_type_inference(
+            dtype=dt, stop_gradient=True)
+    inputs = {"Inference": input, "Label": label}
+    if seq_length is not None:
+        inputs["SeqLength"] = seq_length
+    helper.append_op(
+        "chunk_eval", inputs=inputs, outputs=outs,
+        attrs={"num_chunk_types": num_chunk_types,
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return (outs["Precision"], outs["Recall"], outs["F1-Score"],
+            outs["NumInferChunks"], outs["NumLabelChunks"],
+            outs["NumCorrectChunks"])
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """Greedy CTC decode: per-step argmax then ctc_align merge/blank
+    removal (reference: layers/nn.py ctc_greedy_decoder). ``input``
+    [B, T, C] probabilities; returns (decoded [B, T] left-compacted with
+    -1/0 padding, out_length [B, 1])."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    top1 = argmax(input, axis=-1)
+    decoded = helper.create_variable_for_type_inference(
+        dtype="int64", stop_gradient=True)
+    out_len = helper.create_variable_for_type_inference(
+        dtype="int32", stop_gradient=True)
+    inputs = {"Input": top1}
+    if input_length is not None:
+        inputs["InputLength"] = input_length
+    helper.append_op(
+        "ctc_align", inputs=inputs,
+        outputs={"Output": decoded, "OutputLength": out_len},
+        attrs={"blank": blank, "merge_repeated": True})
+    return decoded, out_len

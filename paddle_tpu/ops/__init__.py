@@ -15,6 +15,7 @@ from paddle_tpu.ops import (  # noqa: F401
     decode_ops,
     detection_ops,
     math_ops,
+    metric_ops,
     moe_ops,
     nn_ops,
     optimizer_ops,

@@ -299,3 +299,36 @@ def _edit_distance(ins, attrs):
         "Out": [dist[:, None]],
         "SequenceNum": [jnp.asarray([b], jnp.int64)],
     }
+
+
+@register_op("ctc_align", no_grad=True)
+def _ctc_align(ins, attrs):
+    """CTC decode alignment: merge repeats, drop blanks (reference:
+    ctc_align_op.h). Dense form: Input [B, T] int tokens (+ optional
+    InputLength [B]); Output [B, T] left-compacted with ``padding_value``
+    (default 0) fill, OutputLength [B] kept tokens per row; a row with
+    nothing kept emits -1 at position 0 (reference's empty-sequence
+    convention)."""
+    x = ins["Input"][0]
+    li = ins.get("InputLength")
+    length = li[0] if li else None
+    blank = int(attrs.get("blank", 0))
+    merge = bool(attrs.get("merge_repeated", True))
+    pad_v = int(attrs.get("padding_value", 0))
+    x2 = x.reshape(x.shape[0], -1).astype(jnp.int32)
+    b, t = x2.shape
+    valid = (jnp.arange(t)[None] < length.reshape(-1, 1)
+             ) if length is not None else jnp.ones((b, t), bool)
+    prev = jnp.pad(x2[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+    keep = valid & (x2 != blank)
+    if merge:
+        keep = keep & (x2 != prev)
+    # left-compact kept tokens (stable order)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    compact = jnp.take_along_axis(x2, order, 1)
+    n_keep = jnp.sum(keep, 1)
+    pos = jnp.arange(t)[None]
+    out = jnp.where(pos < n_keep[:, None], compact, pad_v)
+    out = jnp.where((n_keep == 0)[:, None] & (pos == 0), -1, out)
+    return {"Output": [out.astype(x.dtype)],
+            "OutputLength": [n_keep.astype(jnp.int32).reshape(-1, 1)]}

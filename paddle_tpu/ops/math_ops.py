@@ -491,3 +491,35 @@ def _gaussian_random_batch_size_like(ins, attrs, rng=None):
     out = mean + std * jax.random.normal(
         rng, tuple(shape), dtype=attrs.get("dtype", "float32"))
     return {"Out": [out]}
+
+
+@register_op("cross_entropy2", diff_inputs=("X",))
+def _cross_entropy2(ins, attrs):
+    """Hard-label cross entropy also emitting the matched probability
+    (reference: cross_entropy_op.h CrossEntropyOpKernel2): Y [N, 1] =
+    -log(X[i, label_i]), MatchX the picked probabilities, XShape for
+    reshape-style reconstruction."""
+    x = _x(ins)
+    label = _x(ins, "Label")
+    ignore_index = int(attrs.get("ignore_index", -100))
+    c = x.shape[-1]
+    x2 = x.reshape(-1, c)
+    lab = label.reshape(-1).astype(jnp.int32)
+    safe = jnp.clip(lab, 0, c - 1)
+    match = jnp.take_along_axis(x2, safe[:, None], 1)
+    y = -jnp.log(jnp.maximum(match, 1e-20))
+    ignored = (lab == ignore_index)[:, None]
+    y = jnp.where(ignored, 0.0, y)
+    out_shape = tuple(x.shape[:-1]) + (1,)
+    return {
+        "Y": [y.reshape(out_shape).astype(x.dtype)],
+        "MatchX": [jnp.where(ignored, 1.0, match).reshape(out_shape)
+                   .astype(x.dtype)],
+        "XShape": [jnp.zeros(tuple(x.shape) + (0,), x.dtype)],
+    }
+
+
+@register_op("fill_zeros_like2", no_grad=True)
+def _fill_zeros_like2(ins, attrs):
+    x = _x(ins)
+    return {"Out": [jnp.zeros_like(x)]}

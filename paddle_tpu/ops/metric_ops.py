@@ -1,0 +1,234 @@
+"""Evaluation-metric ops: chunk_eval, precision_recall,
+positive_negative_pair.
+
+Reference kernels: paddle/fluid/operators/{chunk_eval_op.h,
+metrics/precision_recall_op.h, positive_negative_pair_op.h}. Dense
+design: LoD sequence inputs become padded [B, T] tensors with a
+SeqLength input; the metric outputs (scalar counts/ratios) are identical
+to the reference's.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    v = ins.get(slot)
+    return v[i] if v else None
+
+
+def _chunk_marks(tags, types, valid, scheme, other_type):
+    """(starts, ends) boolean marks per position for one tag sequence.
+
+    IOB: tag 0 = begin, 1 = inside. plain: every tag is its own chunk.
+    A chunk starts at B, or at I whose predecessor is padding/other/a
+    different type (the reference's malformed-sequence tolerance in
+    ChunkEvalKernel::GetSegments). It ends before a start or at the
+    sequence end."""
+    if scheme == "plain":
+        is_chunk = valid & (types != other_type)
+        starts = is_chunk
+        ends = is_chunk
+        return starts, ends, is_chunk
+    # IOB
+    is_chunk = valid & (types != other_type)
+    prev_chunk = jnp.pad(is_chunk[:, :-1], ((0, 0), (1, 0)))
+    prev_type = jnp.pad(types[:, :-1], ((0, 0), (1, 0)),
+                        constant_values=-1)
+    begins = is_chunk & (
+        (tags == 0)
+        | ~prev_chunk
+        | (prev_type != types)
+    )
+    next_begin = jnp.pad(begins[:, 1:], ((0, 0), (0, 1)))
+    next_chunk = jnp.pad(is_chunk[:, 1:], ((0, 0), (0, 1)))
+    ends = is_chunk & (next_begin | ~next_chunk)
+    return begins, ends, is_chunk
+
+
+@register_op("chunk_eval", no_grad=True)
+def _chunk_eval(ins, attrs):
+    """Chunk-level precision/recall/F1 for sequence tagging (reference:
+    chunk_eval_op.h). Inference/Label [B, T] int labels encoded
+    ``chunk_type * num_tag_types + tag`` (IOB: B=0, I=1), SeqLength [B]
+    optional. Schemes: 'IOB' (default) and 'plain'."""
+    infer = _x(ins, "Inference")
+    label = _x(ins, "Label")
+    length = _x(ins, "SeqLength")
+    num_chunk_types = int(attrs["num_chunk_types"])
+    scheme = attrs.get("chunk_scheme", "IOB")
+    excluded = set(int(t) for t in attrs.get("excluded_chunk_types", []))
+    if scheme not in ("IOB", "plain"):
+        raise ValueError(f"chunk_eval: unsupported scheme '{scheme}' "
+                         "(IOB and plain implemented)")
+    num_tags = 1 if scheme == "plain" else 2
+    other_type = num_chunk_types  # labels >= num_chunk_types*num_tags
+    infer = infer.reshape(infer.shape[0], -1).astype(jnp.int32)
+    label = label.reshape(label.shape[0], -1).astype(jnp.int32)
+    b, t = infer.shape
+    if length is not None:
+        valid = (jnp.arange(t)[None, :]
+                 < length.reshape(-1, 1).astype(jnp.int32))
+    else:
+        valid = jnp.ones((b, t), bool)
+
+    def split(x):
+        types = jnp.where(x < other_type * num_tags, x // num_tags,
+                          other_type)
+        tags = x % num_tags
+        for e in excluded:
+            types = jnp.where(types == e, other_type, types)
+        return tags, types
+
+    i_tag, i_type = split(infer)
+    l_tag, l_type = split(label)
+    i_start, i_end, _ = _chunk_marks(i_tag, i_type, valid, scheme,
+                                     other_type)
+    l_start, l_end, _ = _chunk_marks(l_tag, l_type, valid, scheme,
+                                     other_type)
+    num_infer = jnp.sum(i_start)
+    num_label = jnp.sum(l_start)
+    # a correct chunk: same start position, same type, same end position.
+    # end-position match: the next end at-or-after each start must agree.
+    # Dense form: segment ids via cumsum of starts; a chunk is correct iff
+    # start/end/type align, i.e. positions where both start AND the two
+    # chunks end together with equal types throughout. Since chunks are
+    # contiguous runs, it suffices that starts coincide, types at the
+    # start coincide, and the ends nearest those starts coincide — which
+    # is equivalent to: every position of the chunk is marked chunk in
+    # both with the same type, bounded by common start/end marks.
+    both_start = i_start & l_start & (i_type == l_type)
+    # A chunk is correct iff it jointly starts at some p (same type),
+    # stays matching (no single-sided start, types equal) through its
+    # extent, and jointly ends at the same q. Left-to-right scan per row
+    # tracking whether the current jointly-started chunk still matches:
+    run_ok = (i_type == l_type) & valid
+
+    def row(bs, le, ie, ok, lst, ist):
+        def body(carry, x):
+            # walking left-to-right tracking whether the current jointly-
+            # started chunk is still matching
+            active, = carry
+            bstart, lend, iend, okx, lstart, istart = x
+            active = jnp.where(bstart, True, active)
+            # a new single-sided start breaks the match
+            active = active & okx & ~(
+                (lstart | istart) & ~bstart)
+            corr = active & lend & iend
+            # chunk closed
+            active = active & ~(lend | iend)
+            return (active,), corr
+
+        (_,), corr = jax.lax.scan(
+            body, (jnp.asarray(False),),
+            (bs, le, ie, ok, lst, ist))
+        return corr
+
+    corr = jax.vmap(row)(both_start, l_end, i_end, run_ok,
+                         l_start, i_start)
+    num_correct = jnp.sum(corr)
+    num_infer_f = num_infer.astype(jnp.float32)
+    num_label_f = num_label.astype(jnp.float32)
+    num_corr_f = num_correct.astype(jnp.float32)
+    precision = jnp.where(num_infer_f > 0, num_corr_f / num_infer_f, 0.0)
+    recall = jnp.where(num_label_f > 0, num_corr_f / num_label_f, 0.0)
+    f1 = jnp.where(precision + recall > 0,
+                   2 * precision * recall / (precision + recall), 0.0)
+    as1 = lambda v: v.reshape(1)
+    return {
+        "Precision": [as1(precision)],
+        "Recall": [as1(recall)],
+        "F1-Score": [as1(f1)],
+        "NumInferChunks": [as1(num_infer.astype(jnp.int64))],
+        "NumLabelChunks": [as1(num_label.astype(jnp.int64))],
+        "NumCorrectChunks": [as1(num_correct.astype(jnp.int64))],
+    }
+
+
+@register_op("precision_recall", no_grad=True)
+def _precision_recall(ins, attrs):
+    """Multi-class precision/recall/F1, macro + micro averaged
+    (reference: metrics/precision_recall_op.h). MaxProbs [N, 1] with
+    Indices [N, 1] (argmax class), Labels [N, 1]; optional Weights.
+    Outputs BatchMetrics [6] (macro P/R/F1, micro P/R/F1) and
+    AccumMetrics/AccumStatesInfo for streaming (accumulated with the
+    optional StatesInfo input [C, 4])."""
+    indices = _x(ins, "Indices").reshape(-1).astype(jnp.int32)
+    labels = _x(ins, "Labels").reshape(-1).astype(jnp.int32)
+    weights = _x(ins, "Weights")
+    states_in = _x(ins, "StatesInfo")
+    c = int(attrs["class_number"])
+    w = (weights.reshape(-1).astype(jnp.float32)
+         if weights is not None else jnp.ones(labels.shape, jnp.float32))
+    onehot_pred = jax.nn.one_hot(indices, c, dtype=jnp.float32)
+    onehot_lab = jax.nn.one_hot(labels, c, dtype=jnp.float32)
+    tp = jnp.sum(onehot_pred * onehot_lab * w[:, None], 0)       # [C]
+    fp = jnp.sum(onehot_pred * (1 - onehot_lab) * w[:, None], 0)
+    fn = jnp.sum((1 - onehot_pred) * onehot_lab * w[:, None], 0)
+    tn = jnp.sum(w) - tp - fp - fn
+
+    def metrics(tp, fp, fn):
+        prec = jnp.where(tp + fp > 0, tp / (tp + fp), 1.0)
+        rec = jnp.where(tp + fn > 0, tp / (tp + fn), 1.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / (prec + rec), 0.0)
+        return prec, rec, f1
+
+    mp, mr, mf = metrics(tp, fp, fn)
+    macro = jnp.stack([jnp.mean(mp), jnp.mean(mr), jnp.mean(mf)])
+    up, ur, uf = metrics(jnp.sum(tp), jnp.sum(fp), jnp.sum(fn))
+    batch = jnp.concatenate([macro, jnp.stack([up, ur, uf])])
+    states = jnp.stack([tp, fp, tn, fn], axis=1)                 # [C, 4]
+    if states_in is not None:
+        states = states + states_in.astype(jnp.float32)
+    atp, afp, _atn, afn = (states[:, 0], states[:, 1], states[:, 2],
+                           states[:, 3])
+    amp_, amr, amf = metrics(atp, afp, afn)
+    amacro = jnp.stack([jnp.mean(amp_), jnp.mean(amr), jnp.mean(amf)])
+    aup, aur, auf = metrics(jnp.sum(atp), jnp.sum(afp), jnp.sum(afn))
+    accum = jnp.concatenate([amacro, jnp.stack([aup, aur, auf])])
+    return {
+        "BatchMetrics": [batch],
+        "AccumMetrics": [accum],
+        "AccumStatesInfo": [states],
+    }
+
+
+@register_op("positive_negative_pair", no_grad=True)
+def _positive_negative_pair(ins, attrs):
+    """Ranking pair statistics per query (reference:
+    positive_negative_pair_op.h): among same-query item pairs with
+    different labels, count pairs ranked correctly by Score (positive),
+    incorrectly (negative), ties as neutral (0.5 each side in the
+    reference's ratio; kept as separate Neutral count here, matching the
+    op's three outputs)."""
+    score = _x(ins, "Score").reshape(-1).astype(jnp.float32)
+    label = _x(ins, "Label").reshape(-1).astype(jnp.float32)
+    qid = _x(ins, "QueryID").reshape(-1).astype(jnp.int32)
+    acc_pos = _x(ins, "AccumulatePositivePair")
+    acc_neg = _x(ins, "AccumulateNegativePair")
+    acc_neu = _x(ins, "AccumulateNeutralPair")
+    same_q = qid[:, None] == qid[None, :]
+    upper = jnp.triu(jnp.ones(same_q.shape, bool), k=1)
+    pairs = same_q & upper & (label[:, None] != label[None, :])
+    hi_lab = label[:, None] > label[None, :]
+    hi_score = score[:, None] > score[None, :]
+    eq_score = score[:, None] == score[None, :]
+    pos = jnp.sum(pairs & ~eq_score & (hi_lab == hi_score))
+    neu = jnp.sum(pairs & eq_score)
+    neg = jnp.sum(pairs) - pos - neu
+    pos = pos.astype(jnp.float32)
+    neg = neg.astype(jnp.float32)
+    neu = neu.astype(jnp.float32)
+    if acc_pos is not None:
+        pos = pos + acc_pos.reshape(())
+        neg = neg + acc_neg.reshape(())
+        neu = neu + acc_neu.reshape(())
+    return {
+        "PositivePair": [pos.reshape(1)],
+        "NegativePair": [neg.reshape(1)],
+        "NeutralPair": [neu.reshape(1)],
+    }

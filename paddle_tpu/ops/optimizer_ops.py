@@ -228,3 +228,39 @@ def _adadelta(ins, attrs):
     edx2_new = rho * edx2 + (1 - rho) * jnp.square(upd)
     return {"ParamOut": [p + upd], "AvgSquaredGradOut": [eg2_new],
             "AvgSquaredUpdateOut": [edx2_new]}
+
+
+@register_op("proximal_gd", no_grad=True)
+def _proximal_gd(ins, attrs):
+    """Proximal gradient descent with l1/l2 regularization (reference:
+    operators/optimizers/proximal_gd_op.cc)."""
+    p, g = _g(ins, "Param"), _g(ins, "Grad")
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p - lr * g.astype(p.dtype)
+    if l1 > 0:
+        prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+    return {"ParamOut": [prox / (1.0 + lr * l2)]}
+
+
+@register_op("proximal_adagrad", no_grad=True)
+def _proximal_adagrad(ins, attrs):
+    """Proximal Adagrad (reference:
+    operators/optimizers/proximal_adagrad_op.cc)."""
+    p, g, m = _g(ins, "Param"), _g(ins, "Grad"), _g(ins, "Moment")
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    g = g.astype(p.dtype)
+    m_new = m + jnp.square(g)
+    denom = jnp.sqrt(m_new)
+    # zero-grad elements have zero moment on step one: their update is 0,
+    # not lr*0/0 = NaN
+    step = jnp.where(denom > 0, lr * g / jnp.maximum(denom, 1e-30), 0.0)
+    prox = p - step
+    # the reference applies the SCALAR learning rate in the l1 shrink and
+    # l2 denominator (proximal_adagrad_op.h), not the adaptive rate
+    if l1 > 0:
+        prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+    return {"ParamOut": [prox / (1.0 + lr * l2)], "MomentOut": [m_new]}

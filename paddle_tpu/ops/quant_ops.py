@@ -159,3 +159,19 @@ def _requantize(ins, attrs):
     q = jnp.clip(jnp.round(x.astype(jnp.float32) * scale_out / scale_in),
                  -128, 127).astype(jnp.int8)
     return {"Output": [q]}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             diff_inputs=("X",),
+             inplace={"OutState": "InState", "OutAccum": "InAccum"})
+def _fake_qdq_moving_average_abs_max(ins, attrs):
+    """Quantize-dequantize with a moving-average scale in one op
+    (reference: fake_quantize_op.cc
+    FakeQuantizeDequantizeMovingAverageAbsMaxOp) — the QAT activation
+    pattern emitting the dequantized value directly, STE gradient."""
+    outs = _fake_quantize_moving_average_abs_max(ins, attrs)
+    x = _x(ins)
+    qmax = _qmax(attrs)
+    scale = jnp.maximum(outs["OutScale"][0].reshape(()), 1e-12)
+    outs["Out"] = [_ste(x, scale, qmax).astype(x.dtype)]
+    return outs

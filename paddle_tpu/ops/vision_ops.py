@@ -605,3 +605,116 @@ def _random_crop(ins, attrs, rng=None):
     sizes = list(x.shape[:lead]) + list(shape)
     out = jax.lax.dynamic_slice(x, starts_full, sizes)
     return {"Out": [out]}
+
+
+@register_op("polygon_box_transform", no_grad=True)
+def _polygon_box_transform(ins, attrs):
+    """EAST-style quad geometry decode (reference:
+    detection/polygon_box_transform_op.cc): even channels are x offsets,
+    odd channels y offsets; out = 4*coord - in on a 4px grid."""
+    x = _x(ins, "Input")
+    n, c, h, w = x.shape
+    xs = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    ys = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return {"Output": [jnp.where(even, 4.0 * xs - x, 4.0 * ys - x)]}
+
+
+@register_op("psroi_pool", diff_inputs=("X",))
+def _psroi_pool(ins, attrs):
+    """Position-sensitive RoI average pooling (reference:
+    detection/psroi_pool_op.cc): input channels = output_channels*ph*pw;
+    bin (i, j) of output channel k averages input channel
+    k*ph*pw + i*pw + j over the bin's spatial extent. ROIs [R, 5] rows
+    (batch_idx, x1, y1, x2, y2) — dense analog of the LoD rois."""
+    x = jnp.asarray(_x(ins))
+    rois = jnp.asarray(_x(ins, "ROIs")).astype(jnp.float32)
+    out_c = int(attrs["output_channels"])
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+    if rois.shape[-1] == 5:
+        bidx = rois[:, 0].astype(jnp.int32)
+        boxes = rois[:, 1:]
+    else:
+        bidx = jnp.zeros((rois.shape[0],), jnp.int32)
+        boxes = rois
+
+    def one(bi, box):
+        img = x[bi]                       # [C, H, W]
+        x1 = jnp.round(box[0]) * scale
+        y1 = jnp.round(box[1]) * scale
+        x2 = jnp.round(box[2] + 1.0) * scale
+        y2 = jnp.round(box[3] + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1) / pw
+        rh = jnp.maximum(y2 - y1, 0.1) / ph
+        ii = jnp.arange(h, dtype=jnp.float32)[:, None]
+        jj = jnp.arange(w, dtype=jnp.float32)[None, :]
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                hs, he = y1 + i * rh, y1 + (i + 1) * rh
+                ws, we = x1 + j * rw, x1 + (j + 1) * rw
+                m = ((ii >= jnp.floor(hs)) & (ii < jnp.ceil(he))
+                     & (jj >= jnp.floor(ws)) & (jj < jnp.ceil(we)))
+                area = jnp.maximum(jnp.sum(m), 1.0)
+                base = (i * pw + j)
+                chans = img[base::ph * pw][:out_c]   # [out_c, H, W]
+                outs.append(jnp.sum(
+                    chans * m[None], axis=(1, 2)) / area)
+        o = jnp.stack(outs, 1)            # [out_c, ph*pw]
+        return o.reshape(out_c, ph, pw)
+
+    out = jax.vmap(one)(bidx, boxes)
+    return {"Out": [out.astype(x.dtype)]}
+
+
+@register_op("depthwise_conv2d_transpose", diff_inputs=("Input", "Filter"))
+def _depthwise_conv2d_transpose(ins, attrs):
+    """Depthwise transposed conv = conv2d_transpose with groups = C_in
+    (reference: conv_transpose_op.cc registration)."""
+    from paddle_tpu.core.registry import get_op_def
+
+    a = dict(attrs)
+    a.setdefault("groups", int(jnp.shape(_x(ins, "Input"))[1]))
+    return get_op_def("conv2d_transpose").compute(ins, a)
+
+
+@register_op("max_pool3d_with_index", diff_inputs=("X",))
+def _max_pool3d_with_index(ins, attrs):
+    """3-D max pool emitting flat argmax indices (reference:
+    max_pool_with_index_op.cc)."""
+    x = _x(ins)
+    out = _pool_nd(x, attrs, 3)
+    n, c, od, oh, ow = out.shape
+    d, h, w = x.shape[2], x.shape[3], x.shape[4]
+    if attrs.get("global_pooling", False):
+        ksize, strides, pads = (d, h, w), (d, h, w), (0, 0, 0)
+    else:
+        ksize = _pair3(attrs.get("ksize", [2, 2, 2]))
+        strides = _pair3(attrs.get("strides", ksize))
+        pads = _pair3(attrs.get("paddings", [0, 0, 0]))
+    zs = jnp.arange(od) * strides[0] - pads[0]
+    ys = jnp.arange(oh) * strides[1] - pads[1]
+    xs = jnp.arange(ow) * strides[2] - pads[2]
+
+    def cell(vol, oz, oy, ox):
+        wz = jnp.clip(zs[oz] + jnp.arange(ksize[0]), 0, d - 1)
+        wy = jnp.clip(ys[oy] + jnp.arange(ksize[1]), 0, h - 1)
+        wx = jnp.clip(xs[ox] + jnp.arange(ksize[2]), 0, w - 1)
+        patch = vol[wz][:, wy][:, :, wx]
+        flat = jnp.argmax(patch)
+        iz = flat // (ksize[1] * ksize[2])
+        rem = flat % (ksize[1] * ksize[2])
+        iy, ix = rem // ksize[2], rem % ksize[2]
+        return (wz[iz] * h * w + wy[iy] * w + wx[ix]).astype(jnp.int32)
+
+    oz = jnp.repeat(jnp.arange(od), oh * ow)
+    oy = jnp.tile(jnp.repeat(jnp.arange(oh), ow), od)
+    ox = jnp.tile(jnp.arange(ow), od * oh)
+    idx = jax.vmap(
+        jax.vmap(lambda v: jax.vmap(
+            lambda a, b, e: cell(v, a, b, e))(oz, oy, ox))
+    )(x).reshape(n, c, od, oh, ow)
+    return {"Out": [out], "Mask": [idx]}

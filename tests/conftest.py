@@ -24,3 +24,9 @@ else:
     jax.config.update("jax_num_cpu_devices", 8)
     # Numeric-gradient checks need f64 reference arithmetic.
     jax.config.update("jax_enable_x64", True)
+    # Tests are compile-bound on the CPU backend (hundreds of tiny jits);
+    # dialing XLA optimization down trades irrelevant runtime for compile
+    # time. Opt out with PT_TEST_FULL_OPT=1 (e.g. for perf-sensitive
+    # debugging).
+    if os.environ.get("PT_TEST_FULL_OPT") != "1":
+        jax.config.update("jax_disable_most_optimizations", True)

@@ -27,7 +27,7 @@ from paddle_tpu import layers  # noqa: E402
 from paddle_tpu.incubate.fleet import fleet  # noqa: E402
 
 GLOBAL_BATCH = 32
-STEPS = 5
+STEPS = 3
 DIM, HID, CLS = 16, 32, 4
 
 

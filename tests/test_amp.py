@@ -29,7 +29,7 @@ def _build():
     return main, startup, model
 
 
-def _run(amp, n_steps=6):
+def _run(amp, n_steps=4):
     main, startup, model = _build()
     main._amp = amp
     scope = fluid.Scope()

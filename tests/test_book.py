@@ -50,7 +50,7 @@ def test_book_image_classification_cifar(tmp_path):
         test_prog = main.clone(for_test=True)
         fluid.optimizer.Adam(2e-3).minimize(loss)
     feeder = DataFeeder([img, label])
-    batches = list(reader.batch(dataset.cifar.train10(), 64)())[:80]
+    batches = list(reader.batch(dataset.cifar.train10(), 64)())[:50]
     exe, losses = _train_loop(main, startup, feeder, loss, batches)
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.9, losses[::16]
 

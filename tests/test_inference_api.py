@@ -71,3 +71,86 @@ def test_predictor_isolated_scopes(saved_model):
     p2.scope.set("p1.w", np.zeros_like(p2.scope.find_var("p1.w")))
     (out1,) = p1.run([xv])
     np.testing.assert_allclose(out1, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_warmup_and_run_batch(saved_model):
+    d, xv, ref = saved_model
+    pred = inference.create_predictor(inference.Config(d))
+    pred.warmup(shapes={"x": (4, 16)})
+    # arbitrary batch through fixed-signature executables: 11 rows with
+    # max_batch_size 4 -> 2 full chunks + padded tail, padding dropped
+    big = np.concatenate([xv, xv[:3]])
+    out = pred.run_batch({"x": big}, max_batch_size=4)[0]
+    assert out.shape[0] == 11
+    np.testing.assert_allclose(out[:8], ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out[8:], ref[:3], rtol=1e-5, atol=1e-6)
+    # steady state: only signatures (4,16) compiled — no per-size compiles
+    sigs = {k[4] for k in pred._exe._cache}
+    assert len(sigs) == 1
+
+
+def test_zoo_export_predictor_parity(tmp_path):
+    """Every zoo family round-trips save_inference_model -> Predictor
+    with numeric parity vs the in-process test program (VERDICT r2
+    item 10)."""
+    from paddle_tpu.models import resnet, ssd, vgg
+    from paddle_tpu.models import transformer as T
+
+    cases = {}
+
+    # mnist-style MLP
+    def build_mlp():
+        img = layers.data("img", shape=[64], dtype="float32")
+        probs = layers.softmax(layers.fc(layers.fc(img, 32, act="relu"), 10))
+        feed = {"img": np.random.RandomState(0).randn(4, 64).astype(
+            np.float32)}
+        return ["img"], [probs], feed
+
+    # conv net from the zoo (cifar-shape resnet)
+    def build_resnet():
+        img = layers.data("data", shape=[3, 32, 32], dtype="float32")
+        logits = resnet.resnet_cifar10(img, class_dim=10, depth=20,
+                                       is_test=True)
+        feed = {"data": np.random.RandomState(1).randn(2, 3, 32, 32).astype(
+            np.float32)}
+        return ["data"], [logits], feed
+
+    # vgg (small input)
+    def build_vgg():
+        img = layers.data("pixel", shape=[3, 32, 32], dtype="float32")
+        logits = vgg.vgg16(img, class_dim=10, is_test=True, fc_dim=64)
+        feed = {"pixel": np.random.RandomState(2).randn(2, 3, 32, 32).astype(
+            np.float32)}
+        return ["pixel"], [logits], feed
+
+    # transformer encoder-decoder forward (is_test build)
+    def build_transformer():
+        cfg = T.TransformerConfig(
+            src_vocab_size=100, trg_vocab_size=100, d_model=32, d_inner=64,
+            n_head=2, n_layer=1, max_length=20, dropout=0.0)
+        model = T.build(cfg, is_test=True)
+        feed = T.make_batch(cfg, batch=2, src_len=8, trg_len=8, seed=3)
+        feed.pop("lbl_word", None)
+        feed.pop("lbl_weight", None)
+        names = sorted(feed.keys())
+        return names, [model["logits"]], feed
+
+    builders = {"mlp": build_mlp, "resnet": build_resnet,
+                "vgg": build_vgg, "transformer": build_transformer}
+    for name, build in builders.items():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            feeds, fetches, feed = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        d = str(tmp_path / name)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            ref = exe.run(main, feed=feed, fetch_list=fetches)
+            io.save_inference_model(d, feeds, fetches, exe, main)
+        pred = inference.create_predictor(inference.Config(d))
+        got = pred.run({k: feed[k] for k in pred.get_input_names()})
+        for r, g in zip(ref, got):
+            np.testing.assert_allclose(
+                r, g, rtol=1e-4, atol=1e-5,
+                err_msg=f"zoo model '{name}' predictor mismatch")

@@ -1,0 +1,172 @@
+"""Metric + tail ops added in round 3 (reference:
+operators/{chunk_eval_op.h, metrics/precision_recall_op.h,
+positive_negative_pair_op.h, ctc_align_op.h,
+detection/polygon_box_transform_op.cc, detection/psroi_pool_op.cc,
+optimizers/proximal_*_op.cc, cross_entropy_op.h kernel2})."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.registry import get_op_def
+
+
+def _c(op, ins, attrs=None):
+    return get_op_def(op).compute(
+        {k: [np.asarray(v)] for k, v in ins.items()}, attrs or {})
+
+
+def test_chunk_eval_iob_perfect_and_partial():
+    # labels encoded type*2 + tag (B=0, I=1); other = 2*num_chunk_types+
+    perfect = _c("chunk_eval",
+                 {"Inference": [[0, 1, 2, 0, 1, 6]],
+                  "Label": [[0, 1, 2, 0, 1, 6]]},
+                 {"num_chunk_types": 3})
+    assert float(perfect["F1-Score"][0][0]) == 1.0
+    # chunks: [B I](type0), [B](type1), [B I](type0) = 3
+    assert int(perfect["NumLabelChunks"][0][0]) == 3
+    part = _c("chunk_eval",
+              {"Inference": [[0, 1, 6, 2, 0, 1]],
+               "Label": [[0, 1, 2, 0, 1, 6]]},
+              {"num_chunk_types": 3})
+    # only the first chunk [0,1] matches exactly
+    assert int(part["NumCorrectChunks"][0][0]) == 1
+    assert 0 < float(part["Precision"][0][0]) < 1
+
+
+def test_chunk_eval_respects_seq_length():
+    out = _c("chunk_eval",
+             {"Inference": [[0, 1, 0, 0]], "Label": [[0, 1, 0, 0]],
+              "SeqLength": [2]},
+             {"num_chunk_types": 1})
+    assert int(out["NumLabelChunks"][0][0]) == 1     # tail masked out
+    assert float(out["F1-Score"][0][0]) == 1.0
+
+
+def test_chunk_eval_layer_on_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = layers.data("inf", shape=[6], dtype="int64")
+        lab = layers.data("lab", shape=[6], dtype="int64")
+        p, r, f1, ni, nl, nc = layers.chunk_eval(
+            inf, lab, chunk_scheme="IOB", num_chunk_types=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        vals = exe.run(main, feed={
+            "inf": np.array([[0, 1, 2, 0, 1, 6]], np.int64),
+            "lab": np.array([[0, 1, 2, 0, 1, 6]], np.int64)},
+            fetch_list=[f1, nc])
+    assert float(vals[0][0]) == 1.0 and int(vals[1][0]) == 3
+
+
+def test_precision_recall_metrics():
+    out = _c("precision_recall",
+             {"Indices": [[0], [1], [1]], "Labels": [[0], [1], [0]]},
+             {"class_number": 2})
+    batch = np.asarray(out["BatchMetrics"][0])
+    # micro: tp=2, fp=1, fn=1 -> P=R=2/3
+    np.testing.assert_allclose(batch[3:5], [2 / 3, 2 / 3], rtol=1e-6)
+    states = np.asarray(out["AccumStatesInfo"][0])
+    assert states.shape == (2, 4)
+    # streaming: feeding states back doubles the counts
+    out2 = _c("precision_recall",
+              {"Indices": [[0], [1], [1]], "Labels": [[0], [1], [0]],
+               "StatesInfo": states},
+              {"class_number": 2})
+    np.testing.assert_allclose(np.asarray(out2["AccumStatesInfo"][0]),
+                               2 * states, rtol=1e-6)
+
+
+def test_positive_negative_pair():
+    out = _c("positive_negative_pair",
+             {"Score": [0.9, 0.1, 0.5], "Label": [1.0, 0.0, 0.0],
+              "QueryID": [1, 1, 1]}, {})
+    assert float(out["PositivePair"][0][0]) == 2.0
+    assert float(out["NegativePair"][0][0]) == 0.0
+
+
+def test_ctc_align_merge_and_blank():
+    out = _c("ctc_align", {"Input": [[1, 1, 0, 2, 2, 3],
+                                     [0, 0, 0, 0, 0, 0]]}, {"blank": 0})
+    dec = np.asarray(out["Output"][0])
+    lens = np.asarray(out["OutputLength"][0]).ravel()
+    assert dec[0, :3].tolist() == [1, 2, 3] and lens[0] == 3
+    assert dec[1, 0] == -1 and lens[1] == 0    # empty-sequence convention
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 2, 2, 3), np.float32)
+    out = np.asarray(_c("polygon_box_transform", {"Input": x})["Output"][0])
+    np.testing.assert_allclose(out[0, 0, 0], [0, 4, 8])    # x offsets: 4*w
+    np.testing.assert_allclose(out[0, 1, :, 0], [0, 4])    # y offsets: 4*h
+
+
+def test_psroi_pool_position_sensitive():
+    # each bin reads its own channel group: constant-per-channel input
+    # makes bin (i, j) of output channel k equal channel k*4 + i*2 + j
+    x = np.arange(8, dtype=np.float32)[None, :, None, None] * np.ones(
+        (1, 8, 4, 4), np.float32)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = np.asarray(_c("psroi_pool", {"X": x, "ROIs": rois},
+                        {"output_channels": 2, "pooled_height": 2,
+                         "pooled_width": 2, "spatial_scale": 1.0})["Out"][0])
+    np.testing.assert_allclose(out[0, 0].ravel(), [0, 1, 2, 3])
+    np.testing.assert_allclose(out[0, 1].ravel(), [4, 5, 6, 7])
+
+
+def test_proximal_optimizers_shrink():
+    o = _c("proximal_gd",
+           {"Param": np.ones(3, np.float32), "Grad": np.zeros(3, np.float32),
+            "LearningRate": [1.0]}, {"l1": 0.5, "l2": 0.0})
+    np.testing.assert_allclose(np.asarray(o["ParamOut"][0]), 0.5)
+    o = _c("proximal_adagrad",
+           {"Param": np.ones(3, np.float32),
+            "Grad": np.ones(3, np.float32),
+            "Moment": np.zeros(3, np.float32),
+            "LearningRate": [0.1]}, {"l1": 0.0, "l2": 0.0})
+    np.testing.assert_allclose(np.asarray(o["ParamOut"][0]), 0.9, rtol=1e-5)
+
+
+def test_cross_entropy2_matches_reference_formula():
+    x = np.array([[0.2, 0.8], [0.5, 0.5]], np.float32)
+    lab = np.array([[1], [0]], np.int64)
+    o = _c("cross_entropy2", {"X": x, "Label": lab}, {})
+    np.testing.assert_allclose(np.asarray(o["Y"][0]).ravel(),
+                               -np.log([0.8, 0.5]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(o["MatchX"][0]).ravel(),
+                               [0.8, 0.5], rtol=1e-6)
+
+
+def test_fake_qdq_moving_average_ste():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        o = get_op_def(
+            "fake_quantize_dequantize_moving_average_abs_max").compute(
+            {"X": [x], "InScale": [jnp.asarray([1.0])],
+             "InState": [jnp.asarray([1.0])],
+             "InAccum": [jnp.asarray([1.0])]}, {"bit_length": 8})
+        return jnp.sum(o["Out"][0] * jnp.asarray([1.0, 2.0, 3.0]))
+
+    x = jnp.asarray([0.5, -1.0, 0.25])
+    g = np.asarray(jax.grad(f)(x))
+    np.testing.assert_allclose(g, [1.0, 2.0, 3.0])  # straight-through
+
+
+def test_ctc_greedy_decoder_layer():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        probs = layers.data("p", shape=[4, 3], dtype="float32")
+        dec, dec_len = layers.ctc_greedy_decoder(probs, blank=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    pv = np.zeros((1, 4, 3), np.float32)
+    pv[0, :, :] = [[0.1, 0.8, 0.1], [0.1, 0.8, 0.1],
+                   [0.8, 0.1, 0.1], [0.1, 0.1, 0.8]]
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        d, ln = exe.run(main, feed={"p": pv}, fetch_list=[dec, dec_len])
+    assert d[0, :2].tolist() == [1, 2] and ln[0, 0] == 2

@@ -10,7 +10,7 @@ from paddle_tpu.models import bert, se_resnext, seq2seq
 def test_se_resnext50_trains_one_step():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        model = se_resnext.get_model(data_shape=(3, 64, 64), class_dim=10)
+        model = se_resnext.get_model(data_shape=(3, 48, 48), class_dim=10)
         fluid.optimizer.Momentum(0.01, 0.9).minimize(model["loss"])
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.Scope()

@@ -110,7 +110,7 @@ def test_moe_trains_to_specialize():
     lr = 0.1
     l0 = float(loss(state))
     g = jax.jit(jax.grad(loss))
-    for _ in range(200):
+    for _ in range(120):
         grads = g(state)
         state = jax.tree.map(lambda p, gr: p - lr * gr, state, grads)
     l1 = float(loss(state))

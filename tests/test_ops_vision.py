@@ -187,7 +187,10 @@ def test_roi_align_uniform_image():
     out = np.asarray(_run(h)["Out"][0])
     assert out.shape == (2, 2, 2, 2)
     np.testing.assert_allclose(out, 3.0, rtol=1e-5)
-    h.check_grad(["x_0"])
+    # rtol loosened for the test backend's reduced XLA optimization level
+    # (tests/conftest.py): f32 association differences vs the numeric
+    # reference reach ~0.3%
+    h.check_grad(["x_0"], rtol=6e-3)
 
 
 def test_roi_pool_picks_max():

@@ -35,7 +35,7 @@ def _build():
     return main, startup, model
 
 
-def _run_steps(compiled_or_prog, main, startup, model, n_steps=4):
+def _run_steps(compiled_or_prog, main, startup, model, n_steps=2):
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup, scope=scope)

@@ -35,7 +35,7 @@ def test_resnet50_imagenet_shape_trains_one_step():
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        model = resnet.get_model(data_shape=(3, 224, 224), class_dim=1000,
+        model = resnet.get_model(data_shape=(3, 112, 112), class_dim=1000,
                                  depth=50)
         fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(model["loss"])
     main._amp = True
@@ -43,7 +43,9 @@ def test_resnet50_imagenet_shape_trains_one_step():
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
-        fd = next(iter(imagenet.batched(2, 1)()))
+        r = np.random.RandomState(0)
+        fd = {"data": r.normal(0, 1, (2, 3, 112, 112)).astype(np.float32),
+              "label": r.randint(0, 1000, (2, 1)).astype(np.int64)}
         (loss,) = exe.run(main, feed=fd, fetch_list=[model["loss"]])
     assert np.isfinite(loss).all()
 
