@@ -1,0 +1,129 @@
+// Standalone C++ trainer: train a serialized paddle_tpu Program without
+// writing any Python (reference: paddle/fluid/train/demo/demo_trainer.cc
+// and train/test_train_recognize_digits.cc).
+//
+// TPU-native design: the reference links the whole C++ framework and
+// interprets the ProgramDesc op by op; here the compute path IS XLA via
+// the embedded CPython runtime (the same whole-program compilation the
+// Python front end uses), so this binary is the thin native driver the
+// reference's demo_trainer is — load ProgramDescs, init the scope, run
+// train steps, report losses. Model artifacts come from
+// paddle_tpu.contrib.standalone.save_train_program():
+//   <dir>/main_program.pb, <dir>/startup_program.pb, <dir>/feeds.json
+//
+// Usage: standalone_trainer <model_dir> [steps=10] [batch=8]
+
+#include <Python.h>
+
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+
+namespace {
+
+std::string ReadBinaryFile(const std::string& filename) {
+  std::ifstream fin(filename, std::ios::in | std::ios::binary);
+  if (!fin) {
+    std::cerr << "cannot open " << filename << "\n";
+    std::exit(2);
+  }
+  std::ostringstream ss;
+  ss << fin.rdbuf();
+  return ss.str();
+}
+
+// The embedded driver: deserialize, build synthetic feeds from
+// feeds.json, run startup once and the train step `steps` times. The
+// loss is the first `mean` op's output (the reference demo_trainer's
+// convention).
+const char kDriver[] = R"PY(
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.environ.get("PT_REPO", os.getcwd()))
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.framework import Program  # noqa: E402
+
+main = Program.parse_from_string(MAIN_PB)
+startup = Program.parse_from_string(STARTUP_PB)
+feeds = json.loads(FEEDS_JSON)
+
+loss_name = None
+for op in main.blocks[0].ops:
+    if op.type == "mean":
+        loss_name = op.output_arg_names[0]
+        break
+assert loss_name is not None, "no mean op found for the loss"
+
+exe = fluid.Executor(fluid.CPUPlace())
+scope = fluid.Scope()
+rng = np.random.RandomState(0)
+with fluid.scope_guard(scope):
+    exe.run(startup)
+    for step in range(STEPS):
+        feed = {}
+        for spec in feeds:
+            # leading dynamic dim = batch; any other dynamic dim falls
+            # back to the spec's "dim" hint or 16 (save_train_program
+            # documents passing concrete shapes for NLP-style programs)
+            shape = [(BATCH if i == 0 else int(spec.get("dim", 16)))
+                     if d in (-1, 0) else d
+                     for i, d in enumerate(spec["shape"])]
+            if spec["dtype"].startswith("int"):
+                hi = int(spec.get("max", 2))
+                feed[spec["name"]] = rng.randint(
+                    0, max(hi, 1), shape).astype(spec["dtype"])
+            else:
+                feed[spec["name"]] = rng.normal(
+                    0, 1, shape).astype(spec["dtype"])
+        (loss,) = exe.run(main, feed=feed, fetch_list=[loss_name])
+        print("step %d loss %.6f" % (step, float(np.asarray(loss).ravel()[0])),
+              flush=True)
+)PY";
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::cerr << "usage: " << argv[0] << " <model_dir> [steps] [batch]\n";
+    return 2;
+  }
+  const std::string dir = argv[1];
+  const long steps = argc > 2 ? std::strtol(argv[2], nullptr, 10) : 10;
+  const long batch = argc > 3 ? std::strtol(argv[3], nullptr, 10) : 8;
+
+  const std::string main_pb = ReadBinaryFile(dir + "/main_program.pb");
+  const std::string startup_pb = ReadBinaryFile(dir + "/startup_program.pb");
+  const std::string feeds_json = ReadBinaryFile(dir + "/feeds.json");
+
+  Py_Initialize();
+  PyObject* globals = PyDict_New();
+  PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+  PyDict_SetItemString(
+      globals, "MAIN_PB",
+      PyBytes_FromStringAndSize(main_pb.data(), main_pb.size()));
+  PyDict_SetItemString(
+      globals, "STARTUP_PB",
+      PyBytes_FromStringAndSize(startup_pb.data(), startup_pb.size()));
+  PyDict_SetItemString(globals, "FEEDS_JSON",
+                       PyUnicode_FromStringAndSize(feeds_json.data(),
+                                                   feeds_json.size()));
+  PyDict_SetItemString(globals, "STEPS", PyLong_FromLong(steps));
+  PyDict_SetItemString(globals, "BATCH", PyLong_FromLong(batch));
+
+  PyObject* result = PyRun_String(kDriver, Py_file_input, globals, globals);
+  int rc = 0;
+  if (result == nullptr) {
+    PyErr_Print();
+    rc = 1;
+  } else {
+    Py_DECREF(result);
+  }
+  Py_DECREF(globals);
+  Py_Finalize();
+  return rc;
+}

@@ -1,0 +1,40 @@
+"""Artifacts for the C++ standalone trainer (reference:
+paddle/fluid/train/demo/demo_trainer.cc — train a serialized program
+without writing Python).
+
+``save_train_program`` writes <dir>/{main_program.pb, startup_program.pb,
+feeds.json}; ``csrc/standalone_trainer`` (built by ``make -C csrc
+standalone_trainer``) loads them, initializes the scope, and runs train
+steps with synthetic feeds, printing the per-step loss.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Sequence
+
+from paddle_tpu.framework import Program, Variable
+
+
+def save_train_program(dirname: str, main: Program, startup: Program,
+                       feed_vars: Sequence[Variable],
+                       int_maxes: Optional[Dict[str, int]] = None):
+    """Serialize a TRAINING program pair + feed specs for the native
+    trainer. ``int_maxes``: exclusive upper bound for synthetic integer
+    feeds (e.g. vocabulary/class counts), keyed by feed name."""
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "main_program.pb"), "wb") as f:
+        f.write(main.to_proto().SerializeToString())
+    with open(os.path.join(dirname, "startup_program.pb"), "wb") as f:
+        f.write(startup.to_proto().SerializeToString())
+    specs = []
+    for v in feed_vars:
+        spec = {"name": v.name, "shape": list(v.shape or []),
+                "dtype": str(v.dtype)}
+        if int_maxes and v.name in int_maxes:
+            spec["max"] = int(int_maxes[v.name])
+        specs.append(spec)
+    with open(os.path.join(dirname, "feeds.json"), "w") as f:
+        json.dump(specs, f)
+    return dirname

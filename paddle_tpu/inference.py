@@ -133,6 +133,8 @@ class Predictor:
         predictor's dynamic batching (no recompiles in steady state)."""
         feed = self._as_feed(inputs)
         n = next(iter(feed.values())).shape[0]
+        if n == 0:
+            raise ValueError("run_batch got an empty (0-row) batch")
         for k, v in feed.items():
             if v.shape[0] != n:
                 raise ValueError(

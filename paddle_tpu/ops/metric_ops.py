@@ -223,10 +223,14 @@ def _positive_negative_pair(ins, attrs):
     pos = pos.astype(jnp.float32)
     neg = neg.astype(jnp.float32)
     neu = neu.astype(jnp.float32)
-    if acc_pos is not None:
+    if acc_pos is not None and acc_neg is not None and acc_neu is not None:
         pos = pos + acc_pos.reshape(())
         neg = neg + acc_neg.reshape(())
         neu = neu + acc_neu.reshape(())
+    elif any(a is not None for a in (acc_pos, acc_neg, acc_neu)):
+        raise ValueError(
+            "positive_negative_pair: Accumulate{Positive,Negative,Neutral}"
+            "Pair must be wired together or not at all")
     return {
         "PositivePair": [pos.reshape(1)],
         "NegativePair": [neg.reshape(1)],

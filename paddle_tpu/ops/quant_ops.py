@@ -167,11 +167,7 @@ def _requantize(ins, attrs):
 def _fake_qdq_moving_average_abs_max(ins, attrs):
     """Quantize-dequantize with a moving-average scale in one op
     (reference: fake_quantize_op.cc
-    FakeQuantizeDequantizeMovingAverageAbsMaxOp) — the QAT activation
-    pattern emitting the dequantized value directly, STE gradient."""
-    outs = _fake_quantize_moving_average_abs_max(ins, attrs)
-    x = _x(ins)
-    qmax = _qmax(attrs)
-    scale = jnp.maximum(outs["OutScale"][0].reshape(()), 1e-12)
-    outs["Out"] = [_ste(x, scale, qmax).astype(x.dtype)]
-    return outs
+    FakeQuantizeDequantizeMovingAverageAbsMaxOp). Our moving-average
+    quantize op already emits the dequantized STE value, so this is a
+    registered alias of it."""
+    return _fake_quantize_moving_average_abs_max(ins, attrs)

@@ -1,0 +1,50 @@
+"""C++ standalone trainer (reference: train/demo/demo_trainer.cc,
+train/test_train_recognize_digits.cc): train a serialized program from a
+native binary without writing Python."""
+
+import os
+import re
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import standalone
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+BIN = os.path.join(REPO, "csrc", "standalone_trainer")
+
+
+def _build_binary():
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "csrc"),
+                        "standalone_trainer"], capture_output=True,
+                       text=True)
+    return r.returncode == 0 and os.path.exists(BIN)
+
+
+def test_standalone_trainer_trains(tmp_path):
+    if not _build_binary():
+        pytest.skip("native toolchain unavailable")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[13], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="int64")
+        logits = layers.fc(layers.fc(x, 16, act="relu"), 3)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    d = str(tmp_path / "standalone")
+    # labels drawn from {0, 1} of 3 classes: the logit bias learns to
+    # exclude class 2, so the loss falls below the ln(3) chance level
+    standalone.save_train_program(d, main, startup, [x, y],
+                                  int_maxes={"y": 2})
+    env = {**os.environ, "PT_REPO": REPO, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run([BIN, d, "12", "16"], capture_output=True,
+                         text=True, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr[-800:]
+    losses = [float(m) for m in re.findall(r"loss ([0-9.]+)", out.stdout)]
+    assert len(losses) == 12, out.stdout
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < np.log(3.0) - 0.05, losses
