@@ -34,6 +34,8 @@ def save_train_program(dirname: str, main: Program, startup: Program,
                 "dtype": str(v.dtype)}
         if int_maxes and v.name in int_maxes:
             spec["max"] = int(int_maxes[v.name])
+        if dims and v.name in dims:
+            spec["dim"] = int(dims[v.name])
         specs.append(spec)
     with open(os.path.join(dirname, "feeds.json"), "w") as f:
         json.dump(specs, f)

@@ -170,4 +170,7 @@ def _fake_qdq_moving_average_abs_max(ins, attrs):
     FakeQuantizeDequantizeMovingAverageAbsMaxOp). Our moving-average
     quantize op already emits the dequantized STE value, so this is a
     registered alias of it."""
-    return _fake_quantize_moving_average_abs_max(ins, attrs)
+    outs = _fake_quantize_moving_average_abs_max(ins, attrs)
+    x = _x(ins)
+    outs["Out"] = [outs["Out"][0].astype(x.dtype)]  # _ste promotes via
+    return outs                                     # the f32 scale

@@ -30,3 +30,9 @@ else:
     # debugging).
     if os.environ.get("PT_TEST_FULL_OPT") != "1":
         jax.config.update("jax_disable_most_optimizations", True)
+    # Persistent compile cache: repeat suite runs skip most XLA compiles
+    # (the suite is compile-bound; a warm run is several times faster).
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("PT_TEST_CACHE",
+                                     "/tmp/pt_jax_cache_tests"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
