@@ -19,10 +19,13 @@ from paddle_tpu.framework import Program, Variable
 
 def save_train_program(dirname: str, main: Program, startup: Program,
                        feed_vars: Sequence[Variable],
-                       int_maxes: Optional[Dict[str, int]] = None):
+                       int_maxes: Optional[Dict[str, int]] = None,
+                       dims: Optional[Dict[str, int]] = None):
     """Serialize a TRAINING program pair + feed specs for the native
     trainer. ``int_maxes``: exclusive upper bound for synthetic integer
-    feeds (e.g. vocabulary/class counts), keyed by feed name."""
+    feeds (e.g. vocabulary/class counts), keyed by feed name. ``dims``:
+    concrete size for NON-LEADING dynamic dims (e.g. sequence length),
+    keyed by feed name; without it the native driver falls back to 16."""
     os.makedirs(dirname, exist_ok=True)
     with open(os.path.join(dirname, "main_program.pb"), "wb") as f:
         f.write(main.to_proto().SerializeToString())
