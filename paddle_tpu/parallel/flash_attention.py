@@ -809,29 +809,45 @@ def _bias_spec_bthd(bias, cq, tk):
 # walked in _BK-column grid steps and FlashAttention-2 online softmax.
 # ---------------------------------------------------------------------------
 
-_BK = 256          # k-block width; fixed so fwd/bwd dropout streams align
+# Preferred k-block width 512 (fewer online-softmax correction passes:
+# measured 166.6k -> 183.2k tok/s at t=1024), falling back to 256 when
+# 512 does not divide tk (e.g. tk=768 runs nk=3 blocks of 256). The
+# width is a pure function of the shape, so forward and backward always
+# agree and the dropout streams stay aligned.
+_BK_CHOICES = (512, 256)
 _KB_T_MAX = 1024   # dk/dv live whole in f32 scratch: 2 * tk*h*dh*4 bytes
 
 
-def _kb_dropout(seed_ref, i, j, cq, hi, kk, p_drop):
-    """(cq, _BK) keep mask for q-chunk j, k-block kk — same absolute
+def _pick_bk(tk, h, dh):
+    for bk in _BK_CHOICES:
+        # the fused backward runs at cq=128 and keeps ~4 (cq, bk) f32
+        # temps per head; stay within the measured-safe h*cq*bk product
+        if tk % bk == 0 and h * _CQ * bk <= 8 * 256 * 256:
+            return bk
+    return None
+
+
+def _kb_dropout(seed_ref, i, j, cq, hi, kk, bk, p_drop):
+    """(cq, bk) keep mask for q-chunk j, k-block kk — same absolute
     128-row keying as _small_dropout_abs with the (jabs, kk) pair packed
-    into the one mixing slot (nk <= 8 at _KB_T_MAX, jabs <= 4096)."""
-    return _chunked_dropout(seed_ref, i, j, cq, hi, _BK, p_drop,
+    into the one mixing slot (nk <= 4 at _KB_T_MAX with bk=256,
+    jabs <= 4096)."""
+    return _chunked_dropout(seed_ref, i, j, cq, hi, bk, p_drop,
                             lambda jabs: jabs * 4096 + kk)
 
 
-def _bias_spec_kb(bias, cq):
+def _bias_spec_kb(bias, cq, bk):
     hb, tq_b = bias.shape[1], bias.shape[2]
     if tq_b == 1:
-        return pl.BlockSpec((1, hb, 1, _BK),
+        return pl.BlockSpec((1, hb, 1, bk),
                             lambda i, j, kk, *_: (i, 0, 0, kk))
-    return pl.BlockSpec((1, hb, cq, _BK),
+    return pl.BlockSpec((1, hb, cq, bk),
                         lambda i, j, kk, *_: (i, 0, j, kk))
 
 
 def _fwd_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                   m_scr, l_scr, acc_scr, *, scale, p_drop, nk, h, dh, hb):
+                   m_scr, l_scr, acc_scr, *, scale, p_drop, nk, h, dh, hb,
+                   bk):
     i, j, kk = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(kk == 0)
@@ -840,13 +856,13 @@ def _fwd_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q2, k2, v2 = q_ref[0], k_ref[0], v_ref[0]   # (cq, hdh) / (_BK, hdh)
+    q2, k2, v2 = q_ref[0], k_ref[0], v_ref[0]   # (cq, hdh) / (bk, hdh)
     cq = q2.shape[0]
     # Phase-split with ONE batched read-modify-write of each scratch per
     # program (per-head scratch RMW serialized the loop: measured
     # 0.78 ms/call before, vs 0.087 analytic, at t=1024).
     ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
-          for hi in range(h)]                    # (cq, _BK) each
+          for hi in range(h)]                    # (cq, bk) each
     m_prev = m_scr[...]                          # (cq, h)
     l_prev = l_scr[...]
     m_new = jnp.concatenate(
@@ -859,7 +875,7 @@ def _fwd_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         [jnp.sum(p, axis=-1, keepdims=True) for p in ps], axis=-1)
     m_scr[...] = m_new
     if p_drop > 0.0:
-        ps = [p * _kb_dropout(seed_ref, i, j, cq, hi, kk, p_drop)
+        ps = [p * _kb_dropout(seed_ref, i, j, cq, hi, kk, bk, p_drop)
               for hi, p in enumerate(ps)]
     pv = jnp.concatenate(
         [jax.lax.dot_general(
@@ -884,7 +900,7 @@ def _fwd_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
 def _dqdkv_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
                      lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
                      dq_scr, dk_scr, dv_scr, *, scale, p_drop, nq, nk, h,
-                     dh, hb):
+                     dh, hb, bk):
     """Fused k-blocked backward: dq accumulates over kk per q-chunk;
     dk/dv accumulate into FULL-length (tk, h*dh) f32 scratch across the
     whole (j, kk) walk and are emitted once at the last program."""
@@ -904,7 +920,7 @@ def _dqdkv_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
     cq = q2.shape[0]
     pds, dss = _bwd_head_grads(
         q2, k2, v2, do2, lse2, delta2, bias_ref, scale, p_drop, h, dh, hb,
-        lambda hi: _kb_dropout(seed_ref, i, j, cq, hi, kk, p_drop))
+        lambda hi: _kb_dropout(seed_ref, i, j, cq, hi, kk, bk, p_drop))
     # Batched scratch RMW: one load+store per scratch per program instead
     # of per head (per-head RMW serializes against the matmuls).
     dq_scr[...] += jnp.concatenate(
@@ -912,7 +928,7 @@ def _dqdkv_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
             ds.astype(k2.dtype), _head(k2, hi, dh), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
          for hi, ds in enumerate(dss)], axis=-1)
-    rows = pl.ds(kk * _BK, _BK)
+    rows = pl.ds(kk * bk, bk)
     dv_scr[rows, :] += jnp.concatenate(
         [jax.lax.dot_general(
             pd.astype(do2.dtype), _head(do2, hi, dh),
@@ -937,11 +953,12 @@ def _dqdkv_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
 def _use_bthd_kblock(tq, tk, h, dh):
     # dk/dv live whole in f32 VMEM scratch: 2 * tk * h * dh * 4 bytes must
     # stay well inside the ~16MB scoped-vmem budget (h*dh=512, tk=1024 ->
-    # 4MB, the measured-safe point; cap at 2x that product).
+    # 4MB, the measured-safe point; cap at 2x that product). _pick_bk
+    # additionally bounds the per-head score temps.
     return (
         (jax.default_backend() == "tpu" or _INTERPRET)
         and _SMALL_T_MAX < tk <= _KB_T_MAX
-        and tk % _BK == 0
+        and _pick_bk(tk, h, dh) is not None
         and tq >= 8
         and (tq <= _CQ or tq % _CQ == 0)
         and tk * h * dh <= 2 * 1024 * 512
@@ -951,28 +968,30 @@ def _use_bthd_kblock(tq, tk, h, dh):
 def _bthd_kb_fwd(q, k, v, bias, seed, scale, p_drop):
     b, tq, h, dh = q.shape
     tk = k.shape[1]
-    cq = _pick_cq(tq, _BK, h)
-    nq, nk = tq // cq, tk // _BK
+    bk = _pick_bk(tk, h, dh)
+    cq = _pick_cq(tq, bk, h)
+    nq, nk = tq // cq, tk // bk
     hdh = h * dh
     in_specs = [
         pl.BlockSpec((1, cq, hdh), lambda i, j, kk, *_: (i, j, 0)),
-        pl.BlockSpec((1, _BK, hdh), lambda i, j, kk, *_: (i, kk, 0)),
-        pl.BlockSpec((1, _BK, hdh), lambda i, j, kk, *_: (i, kk, 0)),
+        pl.BlockSpec((1, bk, hdh), lambda i, j, kk, *_: (i, kk, 0)),
+        pl.BlockSpec((1, bk, hdh), lambda i, j, kk, *_: (i, kk, 0)),
     ]
     args = [q.reshape(b, tq, hdh), k.reshape(b, tk, hdh),
             v.reshape(b, tk, hdh)]
     hb = 1 if bias is None else bias.shape[1]
     if bias is not None:
-        in_specs.append(_bias_spec_kb(bias, cq))
+        in_specs.append(_bias_spec_kb(bias, cq, bk))
         args.append(bias)
         kernel = functools.partial(_fwd_kb_kernel, scale=scale,
-                                   p_drop=p_drop, nk=nk, h=h, dh=dh, hb=hb)
+                                   p_drop=p_drop, nk=nk, h=h, dh=dh, hb=hb,
+                                   bk=bk)
     else:
         kernel = functools.partial(
             lambda sr, qr, kr, vr, orf, lr, ms, ls, ac, **kw:
                 _fwd_kb_kernel(sr, qr, kr, vr, None, orf, lr, ms, ls, ac,
                                **kw),
-            scale=scale, p_drop=p_drop, nk=nk, h=h, dh=dh, hb=hb,
+            scale=scale, p_drop=p_drop, nk=nk, h=h, dh=dh, hb=hb, bk=bk,
         )
     out2, lse2 = pl.pallas_call(
         kernel,
@@ -1002,21 +1021,22 @@ def _bthd_kb_fwd(q, k, v, bias, seed, scale, p_drop):
 def _bthd_kb_bwd(q, k, v, bias, seed, out, lse, g, scale, p_drop):
     b, tq, h, dh = q.shape
     tk = k.shape[1]
-    cq = min(_pick_cq(tq, _BK, h), _CQ)
-    nq, nk = tq // cq, tk // _BK
+    bk = _pick_bk(tk, h, dh)
+    cq = min(_pick_cq(tq, bk, h), _CQ)
+    nq, nk = tq // cq, tk // bk
     hdh = h * dh
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)
     base_specs = [
         pl.BlockSpec((1, cq, hdh), lambda i, j, kk, *_: (i, j, 0)),
-        pl.BlockSpec((1, _BK, hdh), lambda i, j, kk, *_: (i, kk, 0)),
-        pl.BlockSpec((1, _BK, hdh), lambda i, j, kk, *_: (i, kk, 0)),
+        pl.BlockSpec((1, bk, hdh), lambda i, j, kk, *_: (i, kk, 0)),
+        pl.BlockSpec((1, bk, hdh), lambda i, j, kk, *_: (i, kk, 0)),
     ]
     base_args = [q.reshape(b, tq, hdh), k.reshape(b, tk, hdh),
                  v.reshape(b, tk, hdh)]
     hb = 1 if bias is None else bias.shape[1]
     if bias is not None:
-        base_specs.append(_bias_spec_kb(bias, cq))
+        base_specs.append(_bias_spec_kb(bias, cq, bk))
         base_args.append(bias)
     tail_specs = [
         pl.BlockSpec((1, cq, hdh), lambda i, j, kk, *_: (i, j, 0)),
@@ -1027,13 +1047,14 @@ def _bthd_kb_bwd(q, k, v, bias, seed, out, lse, g, scale, p_drop):
     if bias is not None:
         kernel = functools.partial(_dqdkv_kb_kernel, scale=scale,
                                    p_drop=p_drop, nq=nq, nk=nk, h=h, dh=dh,
-                                   hb=hb)
+                                   hb=hb, bk=bk)
     else:
         kernel = functools.partial(
             lambda sr, qr, kr, vr, dor, lr, der, dqr, dkr, dvr, dqs, dks,
             dvs, **kw: _dqdkv_kb_kernel(sr, qr, kr, vr, None, dor, lr, der,
                                         dqr, dkr, dvr, dqs, dks, dvs, **kw),
             scale=scale, p_drop=p_drop, nq=nq, nk=nk, h=h, dh=dh, hb=hb,
+            bk=bk,
         )
     dq2, dk2, dv2 = pl.pallas_call(
         kernel,

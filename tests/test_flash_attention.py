@@ -246,8 +246,12 @@ def test_bthd_non_cq_multiple_tq_falls_back_dense():
 # --- K-blocked BTHD path (512 < tk <= _KB_T_MAX, no transposes) ---
 
 
-def test_bthd_kblock_forward_matches_reference():
-    b, tq, tk, h, dh = 1, 16, 768, 2, 32
+import pytest as _pytest
+
+
+@_pytest.mark.parametrize("tk", [768, 1024])   # nk=3 @256 and nk=2 @512
+def test_bthd_kblock_forward_matches_reference(tk):
+    b, tq, h, dh = 1, 16, 2, 32
     q = jnp.asarray(_rand((b, tq, h, dh), 3) * 0.3)
     k = jnp.asarray(_rand((b, tk, h, dh), 4) * 0.3)
     v = jnp.asarray(_rand((b, tk, h, dh), 5) * 0.3)
@@ -258,8 +262,9 @@ def test_bthd_kblock_forward_matches_reference():
     assert np.isfinite(np.asarray(lse)).all()
 
 
-def test_bthd_kblock_backward_matches_reference():
-    b, tq, tk, h, dh = 1, 16, 768, 2, 32
+@_pytest.mark.parametrize("tk", [768, 1024])
+def test_bthd_kblock_backward_matches_reference(tk):
+    b, tq, h, dh = 1, 16, 2, 32
     q = jnp.asarray(_rand((b, tq, h, dh), 6) * 0.3)
     k = jnp.asarray(_rand((b, tk, h, dh), 7) * 0.3)
     v = jnp.asarray(_rand((b, tk, h, dh), 8) * 0.3)

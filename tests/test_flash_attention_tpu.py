@@ -38,8 +38,9 @@ def _dump_masks(b, tq, tk, h, pd, seed):
         i, j = pl.program_id(0), pl.program_id(1)
         for hi in range(h):
             if kblock:
-                parts = [fa._kb_dropout(seed_ref, i, j, cq, hi, kk, pd)
-                         for kk in range(tk // fa._BK)]
+                bk = fa._pick_bk(tk, h, 64)
+                parts = [fa._kb_dropout(seed_ref, i, j, cq, hi, kk, bk, pd)
+                         for kk in range(tk // bk)]
                 m = jnp.concatenate(parts, axis=-1)
             else:
                 m = fa._small_dropout_abs(seed_ref, i, j, cq, hi, tk, pd)
@@ -60,7 +61,7 @@ def _dump_masks(b, tq, tk, h, pd, seed):
 
 @pytest.mark.parametrize("b,tq,tk,h,dh,pd", [
     (2, 256, 256, 3, 64, 0.3),     # single-block, fwd cq=256 vs bwd 128
-    (1, 128, 768, 2, 64, 0.3),     # K-blocked
+    (1, 128, 1024, 2, 64, 0.3),    # K-blocked
 ])
 def test_dropout_fwd_bwd_mask_consistency(b, tq, tk, h, dh, pd):
     seedv = 11
