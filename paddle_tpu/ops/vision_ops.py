@@ -718,3 +718,125 @@ def _max_pool3d_with_index(ins, attrs):
             lambda a, b, e: cell(v, a, b, e))(oz, oy, ox))
     )(x).reshape(n, c, od, oh, ow)
     return {"Out": [out], "Mask": [idx]}
+
+
+@register_op("similarity_focus", no_grad=True)
+def _similarity_focus(ins, attrs):
+    """Similarity-focus mask (reference: similarity_focus_op.h): for each
+    selected index along ``axis``, greedily pick descending-value
+    positions of the remaining two dims with row/col exclusivity (the
+    bipartite-greedy pattern), then set 1 at the picked (row, col) across
+    the WHOLE focus axis; masks of multiple indexes union."""
+    x = _x(ins).astype(jnp.float32)
+    axis = int(attrs.get("axis", 1))
+    indexes = [int(i) for i in attrs.get("indexes", [0])]
+    if x.ndim != 4 or axis not in (1, 2, 3):
+        raise ValueError("similarity_focus expects a 4-D input, axis 1-3")
+    # move the focus axis to position 1
+    perm = [0, axis] + [d for d in (1, 2, 3) if d != axis]
+    inv = [perm.index(d) for d in range(4)]
+    xt = jnp.transpose(x, perm)                  # [N, C_axis, D2, D3]
+    n, _, d2, d3 = xt.shape
+
+    def greedy_mask(plane):                      # [D2, D3] -> 0/1 mask
+        def body(_, state):
+            mask, vals = state
+            idx = jnp.argmax(vals)
+            r, c = idx // d3, idx % d3
+            ok = vals[r, c] > -jnp.inf
+            mask = jnp.where(ok, mask.at[r, c].set(1.0), mask)
+            vals = jnp.where(
+                ok, vals.at[r, :].set(-jnp.inf).at[:, c].set(-jnp.inf),
+                vals)
+            return mask, vals
+
+        mask0 = jnp.zeros((d2, d3), jnp.float32)
+        mask, _ = jax.lax.fori_loop(0, min(d2, d3), body, (mask0, plane))
+        return mask
+
+    masks = []
+    for idx in indexes:
+        masks.append(jax.vmap(greedy_mask)(xt[:, idx]))
+    mask = jnp.minimum(sum(masks), 1.0)          # [N, D2, D3]
+    out = jnp.broadcast_to(mask[:, None], xt.shape)
+    return {"Out": [jnp.transpose(out, inv).astype(_x(ins).dtype)]}
+
+
+@register_op("roi_perspective_transform", diff_inputs=("X",))
+def _roi_perspective_transform(ins, attrs):
+    """Perspective-warp RoI quads to rectangles (reference:
+    detection/roi_perspective_transform_op.cc, the EAST/OCR op). X
+    [N, C, H, W]; ROIs [R, 9] rows (batch_idx, x1, y1, ..., x4, y4) —
+    the dense analog of the LoD [R, 8] + batch offsets. Out
+    [R, C, th, tw], bilinear-sampled, zero outside the source bounds."""
+    x = jnp.asarray(_x(ins)).astype(jnp.float32)
+    rois = jnp.asarray(_x(ins, "ROIs")).astype(jnp.float32)
+    th = int(attrs.get("transformed_height", 1))
+    tw = int(attrs.get("transformed_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+    if rois.shape[-1] == 9:
+        bidx = rois[:, 0].astype(jnp.int32)
+        quads = rois[:, 1:]
+    else:
+        bidx = jnp.zeros((rois.shape[0],), jnp.int32)
+        quads = rois
+
+    def one(bi, q):
+        rx = q[0::2] * scale
+        ry = q[1::2] * scale
+        x0, x1, x2, x3 = rx[0], rx[1], rx[2], rx[3]
+        y0, y1, y2, y3 = ry[0], ry[1], ry[2], ry[3]
+        # normalized width follows the reference's aspect estimate
+        len1 = jnp.sqrt((x0 - x1) ** 2 + (y0 - y1) ** 2)
+        len2 = jnp.sqrt((x1 - x2) ** 2 + (y1 - y2) ** 2)
+        len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+        len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        nh = float(th)
+        nw = jnp.minimum(
+            jnp.round(est_w * (nh - 1) / jnp.maximum(est_h, 1e-6)) + 1.0,
+            float(tw))
+        dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+        dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+        # epsilon mirrors the reference kernel's guard; degenerate or
+        # single-column quads stay finite instead of NaN-poisoning the
+        # whole RoI to zeros
+        den = dx1 * dy2 - dx2 * dy1 + 1e-5
+        nw1 = jnp.maximum(nw - 1.0, 1e-5)
+        nh1 = max(nh - 1.0, 1e-5)
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / nw1
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / nh1
+        m3 = (y1 - y0 + m6 * nw1 * y1) / nw1
+        m4 = (y3 - y0 + m7 * nh1 * y3) / nh1
+        m0 = (x1 - x0 + m6 * nw1 * x1) / nw1
+        m1 = (x3 - x0 + m7 * nh1 * x3) / nh1
+        ii = jnp.arange(th, dtype=jnp.float32)[:, None]      # out y
+        jj = jnp.arange(tw, dtype=jnp.float32)[None, :]      # out x
+        denom = m6 * jj + m7 * ii + 1.0
+        sx = (m0 * jj + m1 * ii + x0) / denom
+        sy = (m3 * jj + m4 * ii + y0) / denom
+        inside = ((sx >= -0.5) & (sx <= w - 0.5)
+                  & (sy >= -0.5) & (sy <= h - 0.5)
+                  & (jj < nw))
+        img = x[bi]
+        # clamp BEFORE floor (reference bilinear_interpolate clamps
+        # in-bounds), so border-band points interpolate, not extrapolate
+        sxc = jnp.clip(sx, 0.0, w - 1.0)
+        syc = jnp.clip(sy, 0.0, h - 1.0)
+        x0i = jnp.floor(sxc)
+        y0i = jnp.floor(syc)
+        x1i = jnp.clip(x0i + 1, 0, w - 1)
+        y1i = jnp.clip(y0i + 1, 0, h - 1)
+        lx, ly = sxc - x0i, syc - y0i
+        xi0, yi0 = x0i.astype(jnp.int32), y0i.astype(jnp.int32)
+        xi1, yi1 = x1i.astype(jnp.int32), y1i.astype(jnp.int32)
+        v = (img[:, yi0, xi0] * (1 - ly) * (1 - lx)
+             + img[:, yi1, xi0] * ly * (1 - lx)
+             + img[:, yi0, xi1] * (1 - ly) * lx
+             + img[:, yi1, xi1] * ly * lx)
+        return jnp.where(inside[None], v, 0.0)
+
+    out = jax.vmap(one)(bidx, quads)
+    return {"Out": [out.astype(_x(ins).dtype)]}

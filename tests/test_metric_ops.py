@@ -170,3 +170,4 @@ def test_ctc_greedy_decoder_layer():
         exe.run(startup)
         d, ln = exe.run(main, feed={"p": pv}, fetch_list=[dec, dec_len])
     assert d[0, :2].tolist() == [1, 2] and ln[0, 0] == 2
+

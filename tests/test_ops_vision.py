@@ -374,3 +374,31 @@ def test_lstmp_shapes_and_grad():
     out = np.asarray(_run(h)["Projection"][0])
     assert out.shape == (2, 4, 3)
     h.check_grad(["input_0", "weight_0", "projweight_0"])
+
+
+def _compute(op, ins, attrs=None):
+    from paddle_tpu.core.registry import get_op_def
+
+    return get_op_def(op).compute(
+        {k: [np.asarray(v)] for k, v in ins.items()}, attrs or {})
+
+
+def test_similarity_focus_greedy_exclusive():
+    x = np.zeros((1, 2, 3, 3), np.float32)
+    x[0, 0] = [[5, 1, 1], [1, 4, 1], [1, 1, 3]]
+    o = _compute("similarity_focus", {"X": x}, {"axis": 1, "indexes": [0]})
+    m = np.asarray(o["Out"][0])
+    # greedy picks the diagonal (5, 4, 3) with row/col exclusivity and
+    # broadcasts the mask over the focus axis
+    np.testing.assert_allclose(m[0, 0], np.eye(3))
+    np.testing.assert_allclose(m[0, 1], np.eye(3))
+
+
+def test_roi_perspective_transform_identity_quad():
+    img = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 0, 3, 3, 0, 3]], np.float32)
+    o = _compute("roi_perspective_transform", {"X": img, "ROIs": rois},
+           {"transformed_height": 4, "transformed_width": 4,
+            "spatial_scale": 1.0})
+    np.testing.assert_allclose(np.asarray(o["Out"][0])[0, 0], img[0, 0],
+                               atol=1e-4)
