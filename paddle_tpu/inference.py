@@ -151,7 +151,15 @@ class Predictor:
                     for k, v in chunk.items()
                 }
             res = self.run(chunk)
-            outs.append([np.asarray(r)[:got] for r in res])
+            res = [np.asarray(r) for r in res]
+            for i, r in enumerate(res):
+                if r.ndim == 0 or r.shape[0] != max_batch_size:
+                    raise ValueError(
+                        f"run_batch fetch #{i} has shape {r.shape}, not "
+                        f"batch-major over batch {max_batch_size}; "
+                        "batch-aggregated or scalar outputs cannot be "
+                        "re-chunked — fetch them via run() instead")
+            outs.append([r[:got] for r in res])
         return [np.concatenate([o[i] for o in outs])
                 for i in range(len(self._fetch_vars))]
 

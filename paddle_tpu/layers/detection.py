@@ -126,7 +126,8 @@ def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
     ``dist_matrix`` [G, P] or batched [N, G, P]."""
     return _op("bipartite_match", {"DistMat": dist_matrix},
                {"match_type": match_type or "bipartite",
-                "dist_threshold": dist_threshold or 0.5},
+                "dist_threshold":
+                    0.5 if dist_threshold is None else dist_threshold},
                out_slots=("ColToRowMatchIndices", "ColToRowMatchDist"),
                dtypes=("int32", None), name=name, stop_gradient=True)
 
@@ -138,7 +139,8 @@ def target_assign(input, matched_indices, negative_indices=None,
     return _op("target_assign",
                {"X": input, "MatchIndices": matched_indices,
                 "NegIndices": negative_indices},
-               {"mismatch_value": mismatch_value or 0.0},
+               {"mismatch_value":
+                    0.0 if mismatch_value is None else mismatch_value},
                out_slots=("Out", "OutWeight"), name=name,
                stop_gradient=True)
 

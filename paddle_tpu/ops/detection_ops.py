@@ -92,8 +92,9 @@ def _target_assign(ins, attrs):
 def _mine_hard_examples(ins, attrs):
     """Hard-negative mining (reference: mine_hard_examples_op.cc,
     max_negative mode): per image, rank unmatched priors by loss and keep
-    the top ``neg_pos_ratio * num_pos`` (at least ``sample_size`` when
-    set). NegIndices [N, P] int32, -1 padded; UpdatedMatchIndices keeps
+    the top ``neg_pos_ratio * num_pos``; in hard_example mining
+    ``sample_size`` replaces that cap (max_negative ignores it, matching
+    the reference). NegIndices [N, P] int32, -1 padded; UpdatedMatchIndices keeps
     matches, sets mined negatives to -1 (they already are)."""
     cls_loss = _x(ins, "ClsLoss")
     loc_loss = _x(ins, "LocLoss")
@@ -112,9 +113,14 @@ def _mine_hard_examples(ins, attrs):
         is_neg = is_neg & (dist < overlap)
     num_pos = jnp.sum(match >= 0, axis=1)
     num_neg = jnp.sum(is_neg, axis=1)
-    want = (jnp.minimum((num_pos * ratio).astype(jnp.int32), num_neg)
-            if sample_size == 0
-            else jnp.minimum(jnp.int32(sample_size), num_neg))
+    # sample_size replaces the ratio cap only for hard_example mining
+    # (reference mine_hard_examples_op.cc); max_negative always uses
+    # neg_pos_ratio * num_pos.
+    mining_type = attrs.get("mining_type", "max_negative")
+    if mining_type == "hard_example" and sample_size > 0:
+        want = jnp.minimum(jnp.int32(sample_size), num_neg)
+    else:
+        want = jnp.minimum((num_pos * ratio).astype(jnp.int32), num_neg)
     masked = jnp.where(is_neg, loss, _NEG)
     order = jnp.argsort(-masked, axis=1)  # hardest negatives first
     rank = jnp.arange(p)[None, :]
@@ -232,10 +238,13 @@ def _yolov3_loss(ins, attrs):
     cls = jnp.sum(_bce_logits(cell[..., 5:], tcls), axis=-1)
     cls_loss = jnp.sum(jnp.where(sel, cls * gt_score, 0.0), axis=1)
 
-    # objectness mask: score at responsible cells, -1 where ignored
+    # objectness mask: score at responsible cells, -1 where ignored.
+    # Padding rows (sel=False) are routed to the out-of-bounds batch index
+    # n and dropped, so a padding row sharing (anchor0, cell 0,0) with a
+    # real positive can never overwrite the real write with a stale value.
     obj = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)      # [N, m, H, W]
-    obj = obj.at[bidx, midx, gj, gi].set(
-        jnp.where(sel, gt_score, obj[bidx, midx, gj, gi]))
+    bidx_sel = jnp.where(sel, bidx, n)
+    obj = obj.at[bidx_sel, midx, gj, gi].set(gt_score, mode="drop")
     obj = jax.lax.stop_gradient(obj)
     obj_logit = xr[:, :, 4]
     obj_loss = jnp.sum(
@@ -294,12 +303,15 @@ def _ssd_loss(ins, attrs):
         col0 = jnp.full((p,), -1, jnp.int32)
         col_match, _ = jax.lax.fori_loop(0, min(g, p), body, (col0, d))
         if match_type == "per_prediction":
-            # unmatched priors additionally match their best gt above
-            # overlap_threshold (reference bipartite_match_op.cc)
+            # unmatched priors additionally match their best gt at or
+            # above overlap_threshold (reference bipartite_match_op.cc
+            # ArgMaxMatch uses >= dist_threshold; same comparison as the
+            # standalone bipartite_match op so both paths agree on
+            # boundary-IoU priors)
             best = jnp.argmax(d, 0).astype(jnp.int32)
             best_d = jnp.max(d, 0)
             col_match = jnp.where(
-                (col_match < 0) & (best_d > overlap_t), best, col_match)
+                (col_match < 0) & (best_d >= overlap_t), best, col_match)
         dist = jnp.where(
             col_match >= 0,
             jnp.take_along_axis(d, jnp.maximum(col_match, 0)[None], 0)[0],

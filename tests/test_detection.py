@@ -127,6 +127,45 @@ def test_yolov3_loss_matches_structure():
     assert np.isfinite(gx).all() and np.any(gx != 0)
 
 
+def test_yolov3_loss_padding_gt_does_not_clobber_cell00():
+    # Regression: a padding gt row (w=h=0) scatters to (anchor 0, cell
+    # 0,0); it must not overwrite a REAL positive living in that exact
+    # slot with a stale pre-scatter value.
+    n, hgrid, c = 1, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    x = np.zeros((n, len(mask) * (5 + c), hgrid, hgrid), np.float32)
+    gt = np.zeros((n, 2, 4), np.float32)
+    # small box centered in cell (0,0): best anchor is anchor 0 = mask[0]
+    gt[0, 0] = [0.06, 0.06, 10.0 / 128.0, 13.0 / 128.0]
+    lbl = np.array([[1, 0]], np.int64)
+    outs = get_op_def("yolov3_loss").compute(
+        {"X": [x], "GTBox": [gt], "GTLabel": [lbl]},
+        {"anchors": anchors, "anchor_mask": mask, "class_num": c,
+         "ignore_thresh": 0.7, "downsample_ratio": 32})
+    obj = np.asarray(outs["ObjectnessMask"][0])
+    assert obj[0, 0, 0, 0] == 1.0   # real positive survives padding row
+
+
+def test_mine_hard_examples_sample_size_gating():
+    # sample_size only applies to hard_example mining; max_negative keeps
+    # the neg_pos_ratio cap (reference mine_hard_examples_op.cc).
+    loss = np.array([[0.1, 0.9, 0.5, 0.7, 0.2]], np.float32)
+    match = np.array([[0, -1, -1, -1, -1]], np.int32)
+    outs = get_op_def("mine_hard_examples").compute(
+        {"ClsLoss": [loss], "MatchIndices": [match]},
+        {"neg_pos_ratio": 2.0, "sample_size": 4,
+         "mining_type": "max_negative"})
+    neg = np.asarray(outs["NegIndices"][0])
+    assert (neg[0] >= 0).sum() == 2  # ratio cap, not sample_size
+    outs = get_op_def("mine_hard_examples").compute(
+        {"ClsLoss": [loss], "MatchIndices": [match]},
+        {"neg_pos_ratio": 2.0, "sample_size": 3,
+         "mining_type": "hard_example"})
+    neg = np.asarray(outs["NegIndices"][0])
+    assert (neg[0] >= 0).sum() == 3  # sample_size governs
+
+
 def test_rpn_target_assign_dense():
     anchors = _boxes(1, 32, 9, size=50.0)[0]
     gt = _boxes(2, 4, 10, size=50.0)
