@@ -9,6 +9,7 @@ from paddle_tpu.layers.detection import *  # noqa: F401,F403
 from paddle_tpu.layers.control_flow import (  # noqa: F401
     DynamicRNN,
     IfElse,
+    Print,
     StaticRNN,
     Switch,
     While,

@@ -42,6 +42,7 @@ __all__ = [
     "increment",
     "array_fill",
     "array_write_step",
+    "Print",
 ]
 
 
@@ -797,3 +798,39 @@ class IfElse:
             merged.append(
                 layers.where(_broadcast_row_mask(self._cond, t), t, f))
         return merged[0] if len(merged) == 1 else merged
+
+
+_PRINT_UID = [0]
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Print a tensor's value whenever it is computed (reference:
+    layers/control_flow.py:135 + operators/print_op.cc). The host print is
+    staged with ``jax.debug.callback`` so it fires every executed step.
+    ``print_phase`` 'backward'/'both' also prints the incoming gradient
+    (emitted as a second print op by the grad maker). ``print_tensor_lod``
+    is accepted for API parity; the dense/padded design has no LoD."""
+    helper = LayerHelper("print")
+    _PRINT_UID[0] += 1
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        "print",
+        inputs={"In": input},
+        outputs={"Out": out},
+        attrs={
+            "first_n": first_n,
+            "summarize": summarize,
+            "message": message or "",
+            "print_tensor_name": print_tensor_name,
+            "print_tensor_type": print_tensor_type,
+            "print_tensor_shape": print_tensor_shape,
+            "print_phase": print_phase.upper(),
+            "is_forward": True,
+            "var_name": input.name,
+            "print_uid": _PRINT_UID[0],
+        },
+    )
+    return out

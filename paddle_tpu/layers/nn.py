@@ -38,6 +38,7 @@ __all__ = [
     "yolo_box", "sequence_conv", "add_position_encoding", "conv3d",
     "spectral_norm", "hsigmoid", "sample_logits",
     "chunk_eval", "ctc_greedy_decoder",
+    "py_func", "hash", "tree_conv",
 ]
 
 
@@ -1526,3 +1527,100 @@ def ctc_greedy_decoder(input, blank, input_length=None, name=None):
         outputs={"Output": decoded, "OutputLength": out_len},
         attrs={"blank": blank, "merge_repeated": True})
     return decoded, out_len
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Register a user Python callable as an operator (reference:
+    layers/nn.py:11059 py_func + operators/py_func_op.cc:105). ``func``
+    runs on the HOST inside the compiled step via ``jax.pure_callback``;
+    ``out`` variables must be pre-created with shapes/dtypes (XLA needs a
+    static callback signature — same contract as the reference's "users
+    should create out beforehand"). ``backward_func`` receives forward
+    inputs, forward outputs, then output gradients (None where absent),
+    and returns input gradients (None = no grad)."""
+    from paddle_tpu.ops.misc_ops import register_py_func
+
+    helper = LayerHelper("py_func")
+    if x is None:
+        x = []
+    elif isinstance(x, Variable):
+        x = [x]
+    if out is None:
+        out_list = []
+    elif isinstance(out, Variable):
+        out_list = [out]
+    else:
+        out_list = list(out)
+    for o in out_list:
+        if not o.shape:
+            raise ValueError(
+                "py_func output shapes must be provided by users manually")
+        if any(int(d) < 0 for d in o.shape):
+            raise ValueError(
+                f"py_func output '{o.name}' has dynamic shape "
+                f"{tuple(o.shape)}; the host callback needs a static XLA "
+                f"signature — declare concrete dims (including batch)")
+    fwd_id = register_py_func(func)
+    bwd_id = register_py_func(backward_func) if backward_func else -1
+    skip = skip_vars_in_backward_input
+    if isinstance(skip, Variable):
+        skip = [skip]
+    skip_names = [v.name if isinstance(v, Variable) else v for v in skip or []]
+    in_out = {v.name for v in list(x) + out_list}
+    for n in skip_names:
+        if n not in in_out:
+            raise ValueError(f"Variable {n} is not found in forward inputs "
+                             f"and outputs")
+    helper.append_op(
+        "py_func",
+        inputs={"X": list(x)},
+        outputs={"Out": out_list},
+        attrs={
+            "forward_callable_id": fwd_id,
+            "backward_callable_id": bwd_id,
+            "out_shapes": [[int(d) for d in o.shape] for o in out_list],
+            "out_dtypes": [str(o.dtype) for o in out_list],
+            "backward_skip_vars": skip_names,
+        },
+    )
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """Multi-seed feature hashing into ``[0, hash_size)`` buckets
+    (reference: layers/nn.py:10456 + operators/hash_op.cc). ``input``
+    [N, d] integer ids; output [N, num_hash, 1]."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference(
+        dtype=input.dtype, stop_gradient=True)
+    helper.append_op(
+        "hash", inputs={"X": input}, outputs={"Out": out},
+        attrs={"num_hash": num_hash, "mod_by": hash_size})
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Tree-based convolution over node features (reference:
+    layers/nn.py:11351 tree_conv + operators/tree_conv_op.cc).
+    ``nodes_vector`` [N, n, f], ``edge_set`` [N, e, 2] directional
+    parent->child 1-indexed edges; output [N, n, output_size,
+    num_filters]."""
+    helper = LayerHelper("tree_conv", name=name, bias_attr=bias_attr,
+                         act=act)
+    dtype = nodes_vector.dtype
+    feature_size = int(nodes_vector.shape[2])
+    w = helper.create_parameter(
+        attr=param_attr, shape=[feature_size, 3, output_size, num_filters],
+        dtype=dtype, is_bias=False)
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        "tree_conv",
+        inputs={"NodesVector": nodes_vector, "EdgeSet": edge_set,
+                "Filter": w},
+        outputs={"Out": out},
+        attrs={"max_depth": max_depth})
+    if bias_attr is not False:
+        out = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(out)

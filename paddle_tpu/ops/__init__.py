@@ -16,6 +16,7 @@ from paddle_tpu.ops import (  # noqa: F401
     detection_ops,
     math_ops,
     metric_ops,
+    misc_ops,
     moe_ops,
     nn_ops,
     optimizer_ops,

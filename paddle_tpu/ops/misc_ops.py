@@ -1,0 +1,381 @@
+"""Host-callback and tail operators: py_func, print, hash, tree_conv.
+
+TPU-native redesigns of the reference's four remaining user-facing ops:
+
+- ``py_func`` (reference: paddle/fluid/operators/py_func_op.cc:105):
+  arbitrary user Python runs on the HOST via ``jax.pure_callback`` staged
+  inside the compiled XLA program, instead of the reference's
+  hold-the-GIL-in-the-executor path. Output shapes/dtypes are declared at
+  graph-build time (XLA needs static signatures); the backward callable is
+  emitted as a second py_func op by a custom grad maker, mirroring the
+  reference's grad-op-desc maker.
+- ``print`` (reference: operators/print_op.cc): identity op whose host
+  side-effect is staged with ``jax.debug.callback`` (survives XLA DCE and
+  runs per executed step, not per trace). ``print_phase`` backward/both is
+  a grad-maker-emitted print op over the incoming gradient.
+- ``hash`` (reference: operators/hash_op.cc — xxHash64 % mod_by): a
+  vectorized FNV-1a-style integer mixer over the last axis, one lane per
+  ``num_hash`` seed. Bucket values differ from xxHash (capability parity:
+  stable multi-seed feature hashing into ``mod_by`` buckets), but the
+  layout [rows, num_hash, 1] and semantics match.
+- ``tree_conv`` (reference: operators/tree_conv_op.cc + math/tree2col.cc):
+  the reference walks each patch with a host DFS and scatters into a
+  tree2col buffer. Here the patch weights become three dense [n, n]
+  matrices built from ``max_depth`` adjacency matmuls (R_{d+1} = R_d @ A),
+  so the whole op is batched matmuls the MXU runs natively — no
+  host graph walk, autodiff via vjp.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.registry import register_op
+
+
+def _x(ins, slot="X", i=0):
+    vals = ins.get(slot)
+    return None if not vals else vals[i]
+
+
+# --------------------------------------------------------------------------
+# py_func
+# --------------------------------------------------------------------------
+
+_PY_FUNC_REGISTRY: List[Callable] = []
+
+
+def register_py_func(fn: Callable) -> int:
+    """Register a host callable; returns its id (the analog of the
+    reference's ``PyFuncRegistry`` in layers/nn.py:11004)."""
+    _PY_FUNC_REGISTRY.append(fn)
+    return len(_PY_FUNC_REGISTRY) - 1
+
+
+def registered_py_func(idx: int) -> Callable:
+    return _PY_FUNC_REGISTRY[idx]
+
+
+def _normalize_results(res, shapes, dtypes):
+    if res is None:
+        res = ()
+    if not isinstance(res, (tuple, list)):
+        res = (res,)
+    out = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        r = res[i] if i < len(res) else None
+        if r is None:
+            # None = "no gradient for this input" (reference py_func
+            # backward contract) -> a zero contribution.
+            out.append(np.zeros(shape, dtype))
+        else:
+            out.append(np.asarray(r).astype(dtype).reshape(shape))
+    return tuple(out)
+
+
+def _py_func_grad_maker(op, block, out_grads, provide, should_skip):
+    """Emit the backward py_func op (reference: py_func_op.cc grad maker —
+    backward inputs are fwd X + fwd Out + Out grads minus skip vars;
+    outputs are the X grads)."""
+    bwd_id = int(op.attrs.get("backward_callable_id", -1))
+    if bwd_id < 0:
+        return []  # no backward_func: non-differentiable boundary
+    skip = set(op.attrs.get("backward_skip_vars") or [])
+    xs = list(op.inputs.get("X") or [])
+    outs = list(op.outputs.get("Out") or [])
+    gs = list((out_grads.get("Out") or []))
+
+    in_names, none_pos = [], []
+    pos = 0
+    for n in xs + outs:
+        if n in skip:
+            continue
+        in_names.append(n)
+        pos += 1
+    for g in gs:
+        if g:
+            in_names.append(g)
+        else:
+            none_pos.append(pos)  # backward_func receives None here
+        pos += 1
+
+    from paddle_tpu.core.registry import get_op_def
+
+    opdef = get_op_def("py_func")
+    g_out_names, g_shapes, g_dtypes = [], [], []
+    for n in xs:
+        src = block._find_var_recursive(n)
+        if should_skip(n, "X", opdef):
+            g_out_names.append("")
+            g_shapes.append([1])
+            g_dtypes.append("float32")
+            continue
+        if src is None or src.shape is None:
+            raise ValueError(
+                f"py_func backward needs a declared shape for input '{n}'")
+        gname = provide(n)
+        block.create_var(name=gname, shape=src.shape, dtype=src.dtype)
+        g_out_names.append(gname)
+        g_shapes.append([int(d) for d in src.shape])
+        g_dtypes.append(str(src.dtype))
+    if not any(g_out_names):
+        return []
+    return [dict(
+        type="py_func",
+        inputs={"X": in_names},
+        outputs={"Out": g_out_names},
+        attrs={
+            "forward_callable_id": bwd_id,
+            "backward_callable_id": -1,
+            "out_shapes": g_shapes,
+            "out_dtypes": g_dtypes,
+            "none_positions": none_pos,
+            # backward_func naturally returns one grad per forward input;
+            # grads for skipped (stop_gradient/int) inputs are discarded
+            # rather than reshaped into the placeholder slots
+            "drop_positions": [i for i, nm in enumerate(g_out_names)
+                               if not nm],
+        },
+    )]
+
+
+@register_op("py_func", grad_maker=_py_func_grad_maker)
+def _py_func(ins, attrs):
+    """User Python staged into the compiled step as a host callback
+    (reference: py_func_op.cc:105). With outputs: ``jax.pure_callback``
+    with declared result shapes. Without outputs: an effect-only
+    ``jax.debug.callback`` (the reference's debug-print usage)."""
+    xs = [x for x in (ins.get("X") or [])]
+    fid = int(attrs["forward_callable_id"])
+    none_pos = set(int(p) for p in (attrs.get("none_positions") or []))
+
+    def host_call(*arrs):
+        fn = registered_py_func(fid)
+        it = iter(arrs)
+        args = [None if i in none_pos else next(it)
+                for i in range(len(arrs) + len(none_pos))]
+        return fn(*args)
+
+    present = [x for x in xs if x is not None]
+    shapes = [tuple(int(d) for d in s) for s in (attrs.get("out_shapes") or [])]
+    dtypes = [np.dtype(d) for d in (attrs.get("out_dtypes") or [])]
+    drop = set(int(p) for p in (attrs.get("drop_positions") or []))
+    if not shapes:
+        jax.debug.callback(lambda *a: host_call(*a), *present)
+        return {}
+    result_shape = tuple(
+        jax.ShapeDtypeStruct(s, d) for s, d in zip(shapes, dtypes))
+
+    def host_fn(*arrs):
+        res = host_call(*arrs)
+        if drop:
+            if res is None:
+                res = ()
+            if not isinstance(res, (tuple, list)):
+                res = (res,)
+            res = [None if i in drop else r for i, r in enumerate(res)]
+        return _normalize_results(res, shapes, dtypes)
+
+    outs = jax.pure_callback(host_fn, result_shape, *present)
+    return {"Out": list(outs)}
+
+
+# --------------------------------------------------------------------------
+# print
+# --------------------------------------------------------------------------
+
+_PRINT_COUNTS: Dict[int, int] = {}
+
+
+def _print_grad_maker(op, block, out_grads, provide, should_skip):
+    """print_phase BACKWARD/BOTH: print the incoming gradient through a
+    second print op, then pass it on as In@GRAD (reference: print_op.cc
+    print_phase attr)."""
+    from paddle_tpu.core.registry import get_op_def
+
+    g = (out_grads.get("Out") or [""])[0]
+    name = (op.inputs.get("In") or [""])[0]
+    if not g or should_skip(name, "In", get_op_def("print")):
+        return []
+    src = block._find_var_recursive(name)
+    gname = provide(name)
+    block.create_var(name=gname, shape=src.shape if src else None,
+                     dtype=src.dtype if src else "float32")
+    phase = str(op.attrs.get("print_phase", "BOTH")).upper()
+    attrs = dict(op.attrs)
+    attrs["is_forward"] = False
+    attrs["var_name"] = str(op.attrs.get("var_name", "")) + "@GRAD"
+    # distinct first_n budget from the forward print (negated uid keys a
+    # separate _PRINT_COUNTS slot; layer uids start at 1)
+    attrs["print_uid"] = -int(op.attrs.get("print_uid", 0))
+    if phase == "FORWARD":
+        # no backward printing: plain identity pass-through
+        return [dict(type="assign", inputs={"X": [g]},
+                     outputs={"Out": [gname]}, attrs={})]
+    return [dict(type="print", inputs={"In": [g]}, outputs={"Out": [gname]},
+                 attrs=attrs)]
+
+
+@register_op("print", grad_maker=_print_grad_maker)
+def _print(ins, attrs):
+    """Identity + staged host print (reference: operators/print_op.cc).
+    first_n counts per op instance (``print_uid`` attr) across executed
+    steps, on the host."""
+    x = _x(ins, "In")
+    first_n = int(attrs.get("first_n", -1))
+    message = str(attrs.get("message", "") or "")
+    summarize = int(attrs.get("summarize", -1))
+    uid = int(attrs.get("print_uid", -1))
+    var_name = str(attrs.get("var_name", ""))
+    show_name = bool(attrs.get("print_tensor_name", True))
+    show_type = bool(attrs.get("print_tensor_type", True))
+    show_shape = bool(attrs.get("print_tensor_shape", True))
+    phase = str(attrs.get("print_phase", "BOTH")).upper()
+    is_forward = bool(attrs.get("is_forward", True))
+
+    do_print = not (is_forward and phase == "BACKWARD")
+
+    def host_print(arr):
+        if first_n >= 0:
+            seen = _PRINT_COUNTS.get(uid, 0)
+            if seen >= first_n:
+                return
+            _PRINT_COUNTS[uid] = seen + 1
+        arr = np.asarray(arr)
+        parts = [f"{int(time.time())}\t{message}\t"]
+        if show_name and var_name:
+            parts.append(f"Tensor[{var_name}]")
+        if show_type:
+            parts.append(f"\n\tdtype: {arr.dtype}")
+        if show_shape:
+            parts.append(f"\n\tshape: {list(arr.shape)}")
+        flat = arr.reshape(-1)
+        if summarize >= 0:
+            flat = flat[:summarize]
+        parts.append(f"\n\tdata: {np.array2string(flat, threshold=1000)}")
+        print("".join(parts), file=sys.stderr)
+
+    if do_print:
+        jax.debug.callback(host_print, x)
+    return {"Out": [x]}
+
+
+# --------------------------------------------------------------------------
+# hash
+# --------------------------------------------------------------------------
+
+_FNV_PRIME = np.uint32(16777619)
+_FNV_BASIS = np.uint32(2166136261)
+
+
+@register_op("hash", no_grad=True)
+def _hash(ins, attrs):
+    """Multi-seed feature hashing (reference: operators/hash_op.cc/.h —
+    out[row, i] = XXH64(row_bytes, seed=i) % mod_by, out dims = in dims
+    minus last + [num_hash, 1]). Here: a per-seed FNV-1a mix over the
+    last-axis integers, vectorized over rows and seeds; same contract
+    (deterministic, uniform over [0, mod_by)), different bucket values
+    than xxHash."""
+    x = _x(ins)
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 100000))
+    xi = x.astype(jnp.uint32)
+    seeds = jnp.arange(num_hash, dtype=jnp.uint32)
+    # h_0 = basis ^ (seed * golden); h = (h ^ elem) * prime per element
+    h = _FNV_BASIS ^ (seeds * jnp.uint32(0x9E3779B9))           # [num_hash]
+    h = jnp.broadcast_to(h, x.shape[:-1] + (num_hash,))
+    for i in range(x.shape[-1]):
+        elem = xi[..., i:i + 1]
+        h = (h ^ elem) * _FNV_PRIME
+        # extra avalanche: xorshift keeps high bits moving
+        h = h ^ (h >> jnp.uint32(15))
+    out = (h % jnp.uint32(mod_by)).astype(x.dtype)
+    return {"Out": [out[..., None]]}
+
+
+# --------------------------------------------------------------------------
+# tree_conv
+# --------------------------------------------------------------------------
+
+
+def _tree_patch_weights(edges, n, max_depth):
+    """Dense patch-weight matrices Wl, Wr, Wt [n, n]: W*[u, v] = eta_*
+    of node v in the patch rooted at u (reference: math/tree2col.cc
+    construct_patch + TreeNode::eta_{l,r,t}). Built from adjacency-matrix
+    powers: R_d[u, v] = v is a depth-d descendant of u, d < max_depth."""
+    e = edges.shape[0]
+    u, v = edges[:, 0], edges[:, 1]
+    valid = (u != 0) & (v != 0)
+    # reference construct_tree stops at the first invalid edge
+    valid = jnp.cumprod(valid.astype(jnp.int32)).astype(bool)
+    uz = jnp.where(valid, u - 1, n)   # 0-based; invalid -> dropped row n
+    vz = jnp.where(valid, v - 1, n)
+
+    adj = jnp.zeros((n, n), jnp.float32).at[uz, vz].set(
+        1.0, mode="drop")                                       # [n, n]
+
+    # child position of v among parent u's children, in edge order
+    same_parent = (u[:, None] == u[None, :]) & valid[None, :] & valid[:, None]
+    before = jnp.tril(same_parent, k=-1)
+    index = 1.0 + jnp.sum(before, axis=1).astype(jnp.float32)   # 1-based
+    pclen = jnp.sum(same_parent, axis=1).astype(jnp.float32)
+    temp_e = jnp.where(pclen <= 1.0, 0.5,
+                       (index - 1.0) / jnp.maximum(pclen - 1.0, 1.0))
+    # scatter per-edge temp to the child node id
+    temp = jnp.zeros((n,), jnp.float32).at[vz].set(
+        temp_e, mode="drop")                                    # [n]
+
+    md = float(max_depth)
+    wl = jnp.zeros((n, n), jnp.float32)
+    wr = jnp.zeros((n, n), jnp.float32)
+    wt = jnp.zeros((n, n), jnp.float32)
+    r_d = jnp.eye(n, dtype=jnp.float32)
+    for d in range(max_depth):
+        eta_t = (md - d) / md
+        one_m = 1.0 - eta_t
+        eta_l_v = one_m * temp                                  # [n]
+        eta_r_v = one_m * (1.0 - eta_l_v)
+        wt = wt + r_d * eta_t
+        wl = wl + r_d * eta_l_v[None, :]
+        wr = wr + r_d * eta_r_v[None, :]
+        if d + 1 < max_depth:
+            r_d = r_d @ adj
+    node_count = jnp.sum(valid) + 1
+    exists = (jnp.arange(n) < node_count).astype(jnp.float32)
+    return wl, wr, wt, exists
+
+
+@register_op("tree_conv", diff_inputs=("NodesVector", "Filter"))
+def _tree_conv(ins, attrs):
+    """Tree-based convolution (reference: tree_conv_op.cc; TBCNN,
+    https://arxiv.org/abs/1409.5718). NodesVector [N, n, f], EdgeSet
+    [N, e, 2] int 1-indexed parent->child ((0, 0) padding), Filter
+    [f, 3, out_size, num_filters] (3 = eta_l/eta_r/eta_t to match the
+    reference's tree2col column layout), Out [N, n, out_size,
+    num_filters]. Patch weights are dense [n, n] matrices so the op is
+    four batched matmuls end to end."""
+    nodes = _x(ins, "NodesVector")
+    edges = _x(ins, "EdgeSet").astype(jnp.int32)
+    filt = _x(ins, "Filter")
+    max_depth = int(attrs.get("max_depth", 2))
+    n = nodes.shape[1]
+    f = nodes.shape[2]
+    assert filt.shape[0] == f and filt.shape[1] == 3
+
+    def one(feat, edge):
+        wl, wr, wt, exists = _tree_patch_weights(edge, n, max_depth)
+        out = (
+            jnp.einsum("uv,vf,fod->uod", wl, feat, filt[:, 0])
+            + jnp.einsum("uv,vf,fod->uod", wr, feat, filt[:, 1])
+            + jnp.einsum("uv,vf,fod->uod", wt, feat, filt[:, 2])
+        )
+        return out * exists[:, None, None]
+
+    out = jax.vmap(one)(nodes.astype(jnp.float32), edges)
+    return {"Out": [out.astype(nodes.dtype)]}

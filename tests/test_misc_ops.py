@@ -1,0 +1,286 @@
+"""py_func / print / hash / tree_conv (the round-4 op tails;
+reference: py_func_op.cc, print_op.cc, hash_op.cc, tree_conv_op.cc)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.registry import get_op_def
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+# --------------------------------------------------------------------------
+# py_func
+# --------------------------------------------------------------------------
+
+
+def test_py_func_forward_and_backward():
+    def fwd_tanh(x):
+        return np.tanh(np.asarray(x))
+
+    # forward input x is skipped; grad from y and dy alone
+    def bwd_tanh(y, dy):
+        return np.asarray(dy) * (1.0 - np.square(np.asarray(y)))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(2, 3), dtype="float32", stop_gradient=False)
+        out = main.global_block().create_var(
+            name="y", shape=(2, 3), dtype="float32")
+        layers.py_func(fwd_tanh, x, out, backward_func=bwd_tanh,
+                       skip_vars_in_backward_input=x)
+        loss = layers.reduce_sum(out)
+        grads = fluid.gradients(loss, x)
+    exe = _exe()
+    xv = np.linspace(-1, 1, 6).astype(np.float32).reshape(2, 3)
+    y, dx = exe.run(main, feed={"x": xv}, fetch_list=[out, grads[0]])
+    np.testing.assert_allclose(np.asarray(y), np.tanh(xv), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(dx), 1.0 - np.tanh(xv) ** 2, rtol=1e-5)
+
+
+def test_py_func_no_output_debug(capfd):
+    seen = []
+
+    def dbg(x):
+        seen.append(np.asarray(x).copy())
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(2,), dtype="float32")
+        layers.py_func(dbg, x, None)
+        out = layers.scale(x, scale=3.0)
+    exe = _exe()
+    r = exe.run(main, feed={"x": np.array([1.0, 2.0], np.float32)},
+                fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(r[0]), [3.0, 6.0])
+    assert seen and np.allclose(seen[0], [1.0, 2.0])
+
+
+def test_py_func_backward_with_stop_gradient_input():
+    # backward_func returns one grad per forward input (the natural
+    # contract); the grad for the stop_gradient input is discarded.
+    def fwd(a, b):
+        return np.asarray(a) * np.asarray(b)
+
+    def bwd(a, b, y, dy):
+        return np.asarray(dy) * np.asarray(b), np.asarray(dy) * np.asarray(a)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = main.global_block().create_var(
+            name="a", shape=(2, 3), dtype="float32", stop_gradient=False)
+        b = main.global_block().create_var(
+            name="b", shape=(2, 3), dtype="float32", stop_gradient=True)
+        out = main.global_block().create_var(
+            name="ab", shape=(2, 3), dtype="float32")
+        layers.py_func(fwd, [a, b], out, backward_func=bwd)
+        loss = layers.reduce_sum(out)
+        grads = fluid.gradients(loss, a)
+    exe = _exe()
+    av = np.arange(6, dtype=np.float32).reshape(2, 3)
+    bv = np.full((2, 3), 2.0, np.float32)
+    da, = exe.run(main, feed={"a": av, "b": bv}, fetch_list=[grads[0]])
+    np.testing.assert_allclose(np.asarray(da), bv, rtol=1e-6)
+
+
+def test_print_first_n_counts_phases_separately(capfd):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(2,), dtype="float32", stop_gradient=False)
+        y = layers.Print(x, message="phase-probe", first_n=2,
+                         print_phase="both")
+        loss = layers.reduce_sum(y)
+        fluid.gradients(loss, x)
+    exe = _exe()
+    for _ in range(3):
+        exe.run(main, feed={"x": np.ones(2, np.float32)}, fetch_list=[loss])
+    err = capfd.readouterr().err
+    # 2 forward + 2 backward prints, not 2 total
+    assert err.count("phase-probe") == 4
+
+
+def test_py_func_skip_var_validation():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(2,), dtype="float32")
+        other = main.global_block().create_var(
+            name="other", shape=(2,), dtype="float32")
+        out = main.global_block().create_var(
+            name="o", shape=(2,), dtype="float32")
+        with pytest.raises(ValueError):
+            layers.py_func(lambda a: a, x, out,
+                           backward_func=lambda a, b, c: None,
+                           skip_vars_in_backward_input=other)
+
+
+# --------------------------------------------------------------------------
+# print
+# --------------------------------------------------------------------------
+
+
+def test_print_forward_and_backward(capfd):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(3,), dtype="float32", stop_gradient=False)
+        shown = layers.Print(x, message="round4-print", summarize=2,
+                             print_phase="both")
+        loss = layers.reduce_sum(layers.scale(shown, scale=2.0))
+        grads = fluid.gradients(loss, x)
+    exe = _exe()
+    r = exe.run(main, feed={"x": np.array([1., 2., 3.], np.float32)},
+                fetch_list=[loss, grads[0]])
+    assert float(np.asarray(r[0])) == pytest.approx(12.0)
+    np.testing.assert_allclose(np.asarray(r[1]), [2.0, 2.0, 2.0])
+    err = capfd.readouterr().err
+    assert "round4-print" in err
+    assert "@GRAD" in err  # backward phase printed the gradient
+
+
+def test_print_first_n(capfd):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="x", shape=(2,), dtype="float32")
+        y = layers.Print(x, message="first-n-probe", first_n=2,
+                         print_phase="forward")
+        out = layers.scale(y, scale=1.0)
+    exe = _exe()
+    for _ in range(4):
+        exe.run(main, feed={"x": np.ones(2, np.float32)}, fetch_list=[out])
+    err = capfd.readouterr().err
+    assert err.count("first-n-probe") == 2
+
+
+# --------------------------------------------------------------------------
+# hash
+# --------------------------------------------------------------------------
+
+
+def test_hash_shape_range_determinism():
+    x = np.array([[1, 2], [3, 4], [1, 2]], np.int64)
+    outs = get_op_def("hash").compute(
+        {"X": [x]}, {"num_hash": 4, "mod_by": 10000})
+    h = np.asarray(outs["Out"][0])
+    assert h.shape == (3, 4, 1)
+    assert (h >= 0).all() and (h < 10000).all()
+    # deterministic; equal rows hash equal, different rows differ
+    h2 = np.asarray(get_op_def("hash").compute(
+        {"X": [x]}, {"num_hash": 4, "mod_by": 10000})["Out"][0])
+    np.testing.assert_array_equal(h, h2)
+    np.testing.assert_array_equal(h[0], h[2])
+    assert (h[0] != h[1]).any()
+    # seeds decorrelate: the 4 hashes of one row are not all equal
+    assert len(set(h[0, :, 0].tolist())) > 1
+
+
+def test_hash_layer():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = main.global_block().create_var(
+            name="ids", shape=(4, 3), dtype="int64")
+        out = layers.hash(x, hash_size=500, num_hash=2)
+    exe = _exe()
+    ids = np.random.RandomState(0).randint(0, 1000, (4, 3)).astype(np.int64)
+    r = exe.run(main, feed={"ids": ids}, fetch_list=[out])
+    h = np.asarray(r[0])
+    assert h.shape == (4, 2, 1) and (h >= 0).all() and (h < 500).all()
+
+
+# --------------------------------------------------------------------------
+# tree_conv
+# --------------------------------------------------------------------------
+
+
+def _ref_tree_conv(nodes, edges, filt, max_depth):
+    """Literal numpy re-derivation of the reference tree2col + conv
+    (math/tree2col.cc construct_patch / Tree2ColFunctor) for parity."""
+    bsz, n, f = nodes.shape
+    _, _, out_size, nf = filt.shape
+    out = np.zeros((bsz, n, out_size, nf), np.float32)
+    md = float(max_depth)
+    for b in range(bsz):
+        children = {i: [] for i in range(1, n + 1)}
+        node_count = 0
+        for (u, v) in edges[b]:
+            if u == 0 or v == 0:
+                break
+            children[int(u)].append(int(v))
+            node_count += 1
+        node_count += 1
+
+        def collect(u, depth):
+            got = []
+            if depth + 1 < max_depth:
+                ch = children[u]
+                for i, v in enumerate(ch):
+                    got.append((v, i + 1, len(ch), depth + 1))
+                    got += collect(v, depth + 1)
+            return got
+
+        for u in range(1, node_count + 1):
+            patch = [(u, 1, 1, 0)] + collect(u, 0)
+            acc = np.zeros((out_size, nf), np.float32)
+            for (v, index, pclen, depth) in patch:
+                eta_t = (md - depth) / md
+                frac = 0.5 if pclen == 1 else (index - 1.0) / (pclen - 1.0)
+                eta_l = (1.0 - eta_t) * frac
+                eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+                feat = nodes[b, v - 1]                       # [f]
+                acc += np.einsum("f,fod->od", feat * eta_l, filt[:, 0])
+                acc += np.einsum("f,fod->od", feat * eta_r, filt[:, 1])
+                acc += np.einsum("f,fod->od", feat * eta_t, filt[:, 2])
+            out[b, u - 1] = acc
+    return out
+
+
+def test_tree_conv_matches_reference_semantics():
+    rng = np.random.RandomState(7)
+    bsz, n, f, out_size, nf, md = 2, 8, 4, 5, 3, 3
+    nodes = rng.randn(bsz, n, f).astype(np.float32)
+    # batch 0: root 1 with children 2,3; 2 has children 4,5. batch 1: chain
+    edges = np.zeros((bsz, 6, 2), np.int32)
+    edges[0, :4] = [[1, 2], [1, 3], [2, 4], [2, 5]]
+    edges[1, :3] = [[1, 2], [2, 3], [3, 4]]
+    filt = rng.randn(f, 3, out_size, nf).astype(np.float32)
+    outs = get_op_def("tree_conv").compute(
+        {"NodesVector": [nodes], "EdgeSet": [edges], "Filter": [filt]},
+        {"max_depth": md})
+    got = np.asarray(outs["Out"][0])
+    want = _ref_tree_conv(nodes, edges, filt, md)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_tree_conv_layer_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        nodes = main.global_block().create_var(
+            name="nodes", shape=(2, 6, 4), dtype="float32",
+            stop_gradient=False)
+        edges = main.global_block().create_var(
+            name="edges", shape=(2, 4, 2), dtype="int32", stop_gradient=True)
+        out = layers.tree_conv(nodes, edges, output_size=5, num_filters=2,
+                               max_depth=2)
+        loss = layers.reduce_sum(out)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = _exe()
+    exe.run(startup)
+    rng = np.random.RandomState(3)
+    feed = {
+        "nodes": rng.randn(2, 6, 4).astype(np.float32),
+        "edges": np.tile(np.array([[1, 2], [1, 3], [2, 4], [0, 0]],
+                                  np.int32), (2, 1, 1)),
+    }
+    l0 = float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss])[0]))
+    for _ in range(5):
+        l1 = float(np.asarray(exe.run(main, feed=feed, fetch_list=[loss])[0]))
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
