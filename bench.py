@@ -14,21 +14,21 @@ from __future__ import annotations
 
 import json
 import sys
-import time
 
 import numpy as np
 
-V5E_PEAK_BF16 = 197e12  # FLOP/s per v5e chip
+from bench_common import (
+    V5E_PEAK_BF16,
+    compile_with_oom_backoff,
+    log,
+    run_windows,
+)
 
 import os
 
 BATCH = int(os.environ.get("PT_BENCH_BATCH", "64"))
 SEQ = int(os.environ.get("PT_BENCH_SEQ", "256"))
 VOCAB = 10000
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
 
 
 def analytic_flops_per_step(cfg, batch, s, t):
@@ -84,33 +84,21 @@ def main():
     log(f"layer mode: {'scan' if use_scan else 'unrolled'}")
     main_prog._amp = True  # bf16 matmuls, f32 master weights
 
-    exe = fluid.Executor()
-    exe.run(startup)
+    def make_exe():
+        e = fluid.Executor()
+        e.run(startup)
+        return e
 
-    batch = BATCH
-    while batch >= 4:
-        try:
-            feed = T.make_batch(cfg, batch, SEQ, SEQ, seed=0)
-            t0 = time.time()
-            exe.run(main_prog, feed=feed, fetch_list=[model["loss"]])
-            log(f"compile+first step: {time.time() - t0:.1f}s (batch={batch})")
-            break
-        except Exception as e:
-            # Only resource exhaustion triggers the halved-batch retry; any
-            # other error is a real bug and must surface, not read as perf 0.
-            msg = f"{type(e).__name__}: {e}"
-            if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg:
-                raise
-            log(f"batch {batch} OOM; halving")
-            batch //= 2
-            exe = fluid.Executor()
-            exe.run(startup)
-    else:
-        print(json.dumps({"metric": "transformer_base_train", "value": 0,
-                          "unit": "tokens/sec", "vs_baseline": 0.0}))
-        return
+    exe, batch = compile_with_oom_backoff(
+        make_exe,
+        lambda e, b: e.run(main_prog,
+                           feed=T.make_batch(cfg, b, SEQ, SEQ, seed=0),
+                           fetch_list=[model["loss"]]),
+        BATCH, floor=4)
 
-    # steady-state timing: feeds pre-staged on device, no per-step host sync
+    # steady-state: feeds pre-staged on device, best-of-3 windows with one
+    # sync per window (shared protocol, bench_common.run_windows; the
+    # tunnel adds +-15% bursty host noise, BASELINE.md methodology)
     import jax as _jax
 
     feeds = [
@@ -118,30 +106,8 @@ def main():
                                                         seed=s).items()}
         for s in range(4)
     ]
-    for f in feeds[:2]:
-        exe.run(main_prog, feed=f, fetch_list=[model["loss"]])
-    # 3x 30-step windows. The tunnel adds bursty host-side noise (measured
-    # +-15% between otherwise identical windows), so the BEST window is the
-    # honest estimate of device throughput and stays the headline `value`;
-    # the mean over all windows is reported alongside so both estimators
-    # are visible in the driver artifact (methodology documented in
-    # BASELINE.md "Measurement methodology").
     steps = 30
-    windows = []
-    loss_v = None
-    for w in range(3):
-        t0 = time.time()
-        loss = None
-        for i in range(steps):
-            loss = exe.run(main_prog, feed=feeds[i % 4],
-                           fetch_list=[model["loss"]], return_numpy=False)
-        loss_v = float(np.asarray(loss[0]))  # sync once per window
-        elapsed = time.time() - t0
-        log(f"window {w}: {steps} steps in {elapsed:.2f}s, "
-            f"loss={loss_v:.3f}")
-        windows.append(elapsed)
-    best = min(windows)
-    mean = sum(windows) / len(windows)
+    best, mean = run_windows(exe, main_prog, model["loss"], feeds, steps)
 
     tokens_per_step = batch * SEQ  # target tokens (reference convention)
     tokens_per_sec = tokens_per_step * steps / best
@@ -175,18 +141,22 @@ def main():
                     except ValueError:
                         pass  # non-JSON line that happens to start with {
             if isinstance(parsed, dict):
-                parsed.pop("resnet50", None)
-                parsed.pop("long_context_t1024", None)
+                # strip the (null) nested rider keys a child bench.py emits
+                for k in ("resnet50", "long_context_t1024", "se_resnext50",
+                          "bert_base", "deepfm"):
+                    parsed.pop(k, None)
             return parsed
         except Exception as e:  # never let a rider kill the headline
             log(f"rider bench failed: {type(e).__name__}: {e}")
             return None
 
     resnet = longctx = None
+    families = {}
     here = os.path.dirname(os.path.abspath(__file__))
     want_resnet = os.environ.get("PT_BENCH_RESNET", "1") == "1"
     want_longctx = os.environ.get("PT_BENCH_LONGCTX", "1") == "1"
-    if want_resnet or want_longctx:
+    want_families = os.environ.get("PT_BENCH_FAMILIES", "1") == "1"
+    if want_resnet or want_longctx or want_families:
         del feeds
         fluid.executor.global_scope().clear()
         exe.close()
@@ -198,10 +168,22 @@ def main():
     if want_longctx:
         longctx = _rider(
             [sys.executable, os.path.join(here, "bench.py")],
-            {"PT_BENCH_BATCH": "8", "PT_BENCH_SEQ": "1024"})
+            {"PT_BENCH_BATCH": "8", "PT_BENCH_SEQ": "1024",
+             "PT_BENCH_FAMILIES": "0"})
         if longctx is not None:
             longctx["metric"] = "transformer_longctx_t1024_tokens_per_sec"
         log(f"long-context t=1024: {longctx}")
+    if want_families:
+        # remaining BASELINE.md rows, one fresh process per family
+        for fam, env in (
+            ("se_resnext", {"PT_BENCH_BATCH": "128"}),
+            ("bert", {"PT_BENCH_BATCH": "64", "PT_BENCH_SEQ": "128"}),
+            ("deepfm", {"PT_BENCH_BATCH": "4096"}),
+        ):
+            families[fam] = _rider(
+                [sys.executable, os.path.join(here, "bench_family.py")],
+                {"PT_BENCH_FAMILY": fam, "PT_BENCH_FAMILIES": "0", **env})
+            log(f"{fam}: {families[fam]}")
 
     print(json.dumps({
         "metric": "transformer_base_train_tokens_per_sec",
@@ -213,6 +195,9 @@ def main():
         "mfu_mean": round(mfu_mean, 4),
         "resnet50": resnet,
         "long_context_t1024": longctx,
+        "se_resnext50": families.get("se_resnext"),
+        "bert_base": families.get("bert"),
+        "deepfm": families.get("deepfm"),
     }))
 
 

@@ -1,0 +1,60 @@
+"""Shared measurement protocol for the bench_* scripts.
+
+ONE copy of the tunnel-noise methodology (BASELINE.md "Measurement
+methodology"): feeds pre-staged on device, 3x30-step windows with a
+single host sync per window, best window = headline device-throughput
+estimate, mean reported alongside. All bench entrypoints import these so
+a protocol change cannot skew one family's numbers against another's.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+V5E_PEAK_BF16 = 197e12  # FLOP/s per v5e chip
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def run_windows(exe, program, loss, feeds, steps=30, n_windows=3):
+    """Returns (best, mean) window seconds."""
+    for fd in feeds[:2]:
+        exe.run(program, feed=fd, fetch_list=[loss])
+    windows = []
+    for w in range(n_windows):
+        t0 = time.time()
+        out = None
+        for i in range(steps):
+            out = exe.run(program, feed=feeds[i % len(feeds)],
+                          fetch_list=[loss], return_numpy=False)
+        loss_v = float(np.asarray(out[0]))  # sync once per window
+        elapsed = time.time() - t0
+        log(f"window {w}: {steps} steps in {elapsed:.2f}s, loss={loss_v:.3f}")
+        windows.append(elapsed)
+    return min(windows), sum(windows) / len(windows)
+
+
+def compile_with_oom_backoff(make_exe, run_first, batch, floor=8):
+    """Compile + run the first step, halving ``batch`` on device OOM.
+    Returns (executor, batch). Any non-OOM error surfaces — it is a real
+    bug, not a perf 0."""
+    while batch >= floor:
+        try:
+            exe = make_exe()
+            t0 = time.time()
+            run_first(exe, batch)
+            log(f"compile+first step: {time.time() - t0:.1f}s "
+                f"(batch={batch})")
+            return exe, batch
+        except Exception as e:
+            msg = f"{type(e).__name__}: {e}"
+            if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg:
+                raise
+            log(f"batch {batch} OOM; halving")
+            batch //= 2
+    raise RuntimeError("all batch sizes OOM")

@@ -13,19 +13,17 @@ from __future__ import annotations
 
 import json
 import sys
-import time
 
-import numpy as np
-
-V5E_PEAK_BF16 = 197e12  # FLOP/s per v5e chip
+from bench_common import (
+    V5E_PEAK_BF16,
+    compile_with_oom_backoff,
+    log,
+    run_windows,
+)
 
 BATCH = 128
 SHAPE = (3, 224, 224)
 CLASSES = 1000
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
 
 
 def resnet50_fwd_flops_per_image() -> float:
@@ -81,52 +79,26 @@ def main():
         fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(model["loss"])
     main_prog._amp = True  # bf16 convs/matmuls, f32 master weights
 
-    exe = fluid.Executor()
-    exe.run(startup)
+    def make_exe():
+        e = fluid.Executor()
+        e.run(startup)
+        return e
 
-    batch = BATCH
-    while batch >= 8:
-        try:
-            feed = next(iter(imagenet.batched(batch, 1)()))
-            t0 = time.time()
-            exe.run(main_prog, feed=feed, fetch_list=[model["loss"]])
-            log(f"compile+first step: {time.time() - t0:.1f}s (batch={batch})")
-            break
-        except Exception as e:
-            msg = f"{type(e).__name__}: {e}"
-            if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg:
-                raise
-            log(f"batch {batch} OOM; halving")
-            batch //= 2
-            exe = fluid.Executor()
-            exe.run(startup)
-    else:
-        print(json.dumps({"metric": "resnet50_train", "value": 0,
-                          "unit": "images/sec", "vs_baseline": 0.0}))
-        return
+    exe, batch = compile_with_oom_backoff(
+        make_exe,
+        lambda e, b: e.run(main_prog,
+                           feed=next(iter(imagenet.batched(b, 1)())),
+                           fetch_list=[model["loss"]]),
+        BATCH, floor=8)
 
     feeds = [
         {k: jax.device_put(v) for k, v in fd.items()}
         for fd in imagenet.batched(batch, 4, seed=33)()
     ]
-    for fd in feeds[:2]:
-        exe.run(main_prog, feed=fd, fetch_list=[model["loss"]])
-    # 3x 30-step windows; best window is the headline (tunnel noise, see
-    # BASELINE.md "Measurement methodology"), mean reported alongside.
+    # best-of-3 windows, one sync per window (bench_common.run_windows;
+    # tunnel-noise methodology in BASELINE.md)
     steps = 30
-    windows = []
-    for w in range(3):
-        t0 = time.time()
-        loss = None
-        for i in range(steps):
-            loss = exe.run(main_prog, feed=feeds[i % 4],
-                           fetch_list=[model["loss"]], return_numpy=False)
-        loss_v = float(np.asarray(loss[0]))  # sync once per window
-        elapsed = time.time() - t0
-        log(f"window {w}: {steps} steps in {elapsed:.2f}s, loss={loss_v:.3f}")
-        windows.append(elapsed)
-    best = min(windows)
-    mean = sum(windows) / len(windows)
+    best, mean = run_windows(exe, main_prog, model["loss"], feeds, steps)
 
     images_per_sec = batch * steps / best
     images_per_sec_mean = batch * steps / mean

@@ -1552,7 +1552,7 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     else:
         out_list = list(out)
     for o in out_list:
-        if not o.shape:
+        if o.shape is None:
             raise ValueError(
                 "py_func output shapes must be provided by users manually")
         if any(int(d) < 0 for d in o.shape):

@@ -285,6 +285,12 @@ def _hash(ins, attrs):
     x = _x(ins)
     num_hash = int(attrs.get("num_hash", 1))
     mod_by = int(attrs.get("mod_by", 100000))
+    # Fold the high word before narrowing so 64-bit ids differing only
+    # above bit 31 don't collide. Under JAX's default x64-disabled mode
+    # int64 feeds are already truncated to int32 at trace entry (the id
+    # space is effectively 32-bit); with jax_enable_x64 the fold is real.
+    if x.dtype in (jnp.int64, jnp.uint64):
+        x = x ^ (x >> 32)
     xi = x.astype(jnp.uint32)
     seeds = jnp.arange(num_hash, dtype=jnp.uint32)
     # h_0 = basis ^ (seed * golden); h = (h ^ elem) * prime per element
