@@ -21,8 +21,38 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def run_windows(exe, program, loss, feeds, steps=30, n_windows=3):
-    """Returns (best, mean) window seconds."""
+def run_windows(exe, program, loss, feeds, steps=30, n_windows=3,
+                multi=None):
+    """Returns (best, mean) window seconds.
+
+    ``multi`` (default on; PT_BENCH_MULTI=0 disables) runs each window
+    as ONE compiled multi-step program (Executor.run_steps — the
+    RunFromDataset-style hot loop). Measured round 4 (after fixing a
+    first-draft bias that re-staged the stacked feeds inside the timed
+    window): ResNet-50 +3% (2497 -> 2574 img/s, MFU 0.311 -> 0.321),
+    transformer and DeepFM equal to step-wise within noise — the
+    compiled loop removes the per-step tunnel dispatch jitter without
+    disturbing donation aliasing."""
+    if multi is None:
+        import os
+
+        multi = os.environ.get("PT_BENCH_MULTI", "1") == "1"
+    if multi:
+        # warmup = one full-size window so only ONE multi-step executable
+        # is compiled (steps is a static arg)
+        exe.run_steps(program, feed_list=feeds, steps=steps,
+                      fetch_list=[loss])
+        windows = []
+        for w in range(n_windows):
+            t0 = time.time()
+            out = exe.run_steps(program, feed_list=feeds, steps=steps,
+                                fetch_list=[loss])
+            loss_v = float(np.asarray(out[0]))
+            elapsed = time.time() - t0
+            log(f"window {w}: {steps} steps in {elapsed:.2f}s, "
+                f"loss={loss_v:.3f}")
+            windows.append(elapsed)
+        return min(windows), sum(windows) / len(windows)
     for fd in feeds[:2]:
         exe.run(program, feed=fd, fetch_list=[loss])
     windows = []
@@ -39,10 +69,15 @@ def run_windows(exe, program, loss, feeds, steps=30, n_windows=3):
     return min(windows), sum(windows) / len(windows)
 
 
+class AllBatchesOOM(RuntimeError):
+    """Every batch size down to the floor hit device OOM."""
+
+
 def compile_with_oom_backoff(make_exe, run_first, batch, floor=8):
     """Compile + run the first step, halving ``batch`` on device OOM.
     Returns (executor, batch). Any non-OOM error surfaces — it is a real
-    bug, not a perf 0."""
+    bug, not a perf 0; total exhaustion raises AllBatchesOOM so callers
+    can emit their documented perf-0 JSON record."""
     while batch >= floor:
         try:
             exe = make_exe()
@@ -57,4 +92,4 @@ def compile_with_oom_backoff(make_exe, run_first, batch, floor=8):
                 raise
             log(f"batch {batch} OOM; halving")
             batch //= 2
-    raise RuntimeError("all batch sizes OOM")
+    raise AllBatchesOOM("all batch sizes OOM")

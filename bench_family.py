@@ -30,13 +30,12 @@ from __future__ import annotations
 
 import json
 import os
-import sys
-import time
 
 import numpy as np
 
 from bench_common import (
     V5E_PEAK_BF16,
+    AllBatchesOOM,
     compile_with_oom_backoff,
     log,
     run_windows,

@@ -180,3 +180,69 @@ def jit_lowered(
         return lowered.fn(state, feeds, jax.random.fold_in(base_key, step))
 
     return jax.jit(step_fn, **kwargs)
+
+
+def jit_lowered_multi(lowered: LoweredBlock, n_feeds: int):
+    """Compile ``n_steps`` training steps as ONE XLA program.
+
+    The returned fn has signature
+    ``fn(state, feeds_stacked, base_key, start_step, n_steps)`` where
+    ``feeds_stacked`` carries each feed with a leading [n_feeds] axis;
+    step ``i`` consumes feed ``i % n_feeds`` and folds ``start_step + i``
+    into the PRNG key, so the random stream is bit-identical to
+    ``n_steps`` successive single-step calls. One host dispatch per
+    window instead of one per step — the whole-loop-compiled analog of
+    the reference's ``Executor::RunFromDataset`` hot loop
+    (reference: framework/executor.cc:120-147, device_worker.h:94
+    ``TrainFiles`` — thread-resident step loops without per-step Python);
+    through the hosted-TPU tunnel the per-dispatch host cost is ~1.7 ms,
+    which at ResNet-50 step times is ~5% of wall clock.
+    """
+    sin = lowered.state_in_names
+    sout = lowered.state_out_names
+    extra_names = tuple(n for n in sout if n not in sin)
+
+    def one(state, feeds_stacked, base_key, step_idx, feed_idx):
+        # step_idx (GLOBAL, uint32) feeds the PRNG fold to match the
+        # single-step path's fold_in(base_key, np.uint32(step)) stream;
+        # feed_idx (LOCAL loop index) drives the rotation so "step i
+        # consumes feed i % n_feeds" holds regardless of executor
+        # history
+        feeds = {
+            k: jax.lax.dynamic_index_in_dim(
+                v, jax.numpy.remainder(feed_idx, n_feeds), 0,
+                keepdims=False
+            )
+            for k, v in feeds_stacked.items()
+        }
+        return lowered.fn(
+            state, feeds, jax.random.fold_in(base_key, step_idx)
+        )
+
+    def multi_fn(state, feeds_stacked, base_key, start_step, n_steps):
+        import jax.numpy as jnp
+
+        shapes = jax.eval_shape(
+            lambda s, f, k: one(s, f, k, start_step, 0),
+            state, feeds_stacked, base_key,
+        )
+        fetch0 = [jnp.zeros(x.shape, x.dtype) for x in shapes[0]]
+        extra0 = {
+            n: jnp.zeros(shapes[1][n].shape, shapes[1][n].dtype)
+            for n in extra_names
+        }
+
+        def body(i, carry):
+            st, _extra, _f = carry
+            idx = start_step + i.astype(jax.numpy.uint32)
+            fetches, new_state = one(st, feeds_stacked, base_key, idx, i)
+            st2 = {n: new_state.get(n, st[n]) for n in sin}
+            ex2 = {n: new_state[n] for n in extra_names}
+            return (st2, ex2, fetches)
+
+        st, ex, fetches = jax.lax.fori_loop(
+            0, n_steps, body, (state, extra0, fetch0)
+        )
+        return fetches, {**st, **ex}
+
+    return jax.jit(multi_fn, static_argnums=(4,), donate_argnums=(0,))
