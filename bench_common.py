@@ -17,6 +17,11 @@ import numpy as np
 V5E_PEAK_BF16 = 197e12  # FLOP/s per v5e chip
 
 
+def _is_oom(exc) -> bool:
+    msg = f"{type(exc).__name__}: {exc}"
+    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+
+
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
@@ -39,9 +44,34 @@ def run_windows(exe, program, loss, feeds, steps=30, n_windows=3,
         multi = os.environ.get("PT_BENCH_MULTI", "1") == "1"
     if multi:
         # warmup = one full-size window so only ONE multi-step executable
-        # is compiled (steps is a static arg)
-        exe.run_steps(program, feed_list=feeds, steps=steps,
-                      fetch_list=[loss])
+        # is compiled (steps is a static arg). The windowed program +
+        # stacked feeds cost more HBM than the single-step program the
+        # OOM backoff validated, so an OOM here falls back to the
+        # step-wise protocol instead of crashing the bench.
+        try:
+            exe.run_steps(program, feed_list=feeds, steps=steps,
+                          fetch_list=[loss])
+        except Exception as e:
+            if not _is_oom(e):
+                raise
+            # Compile-time OOM leaves the donated state untouched, so the
+            # step-wise fallback works; an execution-time OOM after state
+            # donation drops the consumed params from the scope and the
+            # fallback's first run raises "not initialized" — surface
+            # that clearly instead of a confusing cascade.
+            log("multi-step window OOM; falling back to step-wise windows")
+            multi = False
+            try:
+                exe.run(program, feed=feeds[0], fetch_list=[loss])
+            except RuntimeError as e2:
+                if "not initialized" in str(e2):
+                    raise RuntimeError(
+                        "multi-step window OOM consumed the donated "
+                        "training state; rerun the startup program or "
+                        "set PT_BENCH_MULTI=0"
+                    ) from e
+                raise
+    if multi:
         windows = []
         for w in range(n_windows):
             t0 = time.time()
@@ -87,8 +117,7 @@ def compile_with_oom_backoff(make_exe, run_first, batch, floor=8):
                 f"(batch={batch})")
             return exe, batch
         except Exception as e:
-            msg = f"{type(e).__name__}: {e}"
-            if "RESOURCE_EXHAUSTED" not in msg and "Out of memory" not in msg:
+            if not _is_oom(e):
                 raise
             log(f"batch {batch} OOM; halving")
             batch //= 2

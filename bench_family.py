@@ -122,9 +122,14 @@ def main():
             exe.run(startup)
             return exe
 
-        exe, batch = compile_with_oom_backoff(
-            make_exe, lambda e, b: e.run(main_prog, feed=feed(b, 0),
-                                         fetch_list=[model["loss"]]), batch)
+        try:
+            exe, batch = compile_with_oom_backoff(
+                make_exe, lambda e, b: e.run(main_prog, feed=feed(b, 0),
+                                             fetch_list=[model["loss"]]), batch)
+        except AllBatchesOOM:
+            print(json.dumps({"metric": "se_resnext50_train_images_per_sec", "value": 0,
+                              "unit": "images/sec", "vs_baseline": 0.0}))
+            return
         feeds = [{k: jax.device_put(v) for k, v in feed(batch, s).items()}
                  for s in range(4)]
         best, mean = run_windows(exe, main_prog, model["loss"], feeds, steps)
@@ -159,11 +164,17 @@ def main():
             exe.run(startup)
             return exe
 
-        exe, batch = compile_with_oom_backoff(
-            make_exe,
-            lambda e, b: e.run(main_prog,
-                               feed=bert.make_batch(cfg, b, seq, seed=0),
-                               fetch_list=[model["loss"]]), batch)
+        try:
+            exe, batch = compile_with_oom_backoff(
+                make_exe,
+                lambda e, b: e.run(main_prog,
+                                   feed=bert.make_batch(cfg, b, seq, seed=0),
+                                   fetch_list=[model["loss"]]), batch)
+        except AllBatchesOOM:
+            print(json.dumps({"metric": "bert_base_pretrain_tokens_per_sec",
+                              "value": 0, "unit": "tokens/sec",
+                              "vs_baseline": 0.0}))
+            return
         feeds = [{k: jax.device_put(v)
                   for k, v in bert.make_batch(cfg, batch, seq, seed=s).items()}
                  for s in range(4)]
@@ -201,11 +212,17 @@ def main():
             exe.run(startup)
             return exe
 
-        exe, batch = compile_with_oom_backoff(
-            make_exe,
-            lambda e, b: e.run(main_prog,
-                               feed=deepfm.make_batch(cfg, b, seed=0),
-                               fetch_list=[model["loss"]]), batch, floor=256)
+        try:
+            exe, batch = compile_with_oom_backoff(
+                make_exe,
+                lambda e, b: e.run(main_prog,
+                                   feed=deepfm.make_batch(cfg, b, seed=0),
+                                   fetch_list=[model["loss"]]), batch,
+                floor=256)
+        except AllBatchesOOM:
+            print(json.dumps({"metric": "deepfm_train_examples_per_sec",
+                              "value": 0, "unit": "examples/sec"}))
+            return
         feeds = [{k: jax.device_put(v)
                   for k, v in deepfm.make_batch(cfg, batch, seed=s).items()}
                  for s in range(4)]

@@ -92,7 +92,7 @@ def main():
                                fetch_list=[model["loss"]]),
             BATCH, floor=8)
     except AllBatchesOOM:
-        print(json.dumps({"metric": "resnet50_train", "value": 0,
+        print(json.dumps({"metric": "resnet50_train_images_per_sec", "value": 0,
                           "unit": "images/sec", "vs_baseline": 0.0}))
         return
 

@@ -649,7 +649,7 @@ def _dec_weight_specs(cfg):
 
 
 def _layer_scan(x, cfg, specs, body_fn, stack_prefix, is_test,
-                batch_vars=()):
+                batch_vars=(), unroll=1):
     """Run ``body_fn(x_var, weights)`` once per layer via the scan op,
     with each weight kind stacked [n_layer, ...] and scanned.
 
@@ -722,6 +722,7 @@ def _layer_scan(x, cfg, specs, body_fn, stack_prefix, is_test,
             # one scan step per LAYER with a single carried activation:
             # eligible for the GPipe schedule under a strategy pipe_axis
             "pipelinable": True,
+            "unroll": int(unroll),
             "stream_names": [n for n in captured
                              if n in set(batch_vars)],
         },
@@ -730,11 +731,15 @@ def _layer_scan(x, cfg, specs, body_fn, stack_prefix, is_test,
 
 
 def build_scan(cfg: Optional[TransformerConfig] = None,
-               is_test: bool = False):
+               is_test: bool = False, unroll: int = 1):
     """Same model as build() with the layer stacks rolled into scan ops.
     Parameters are stacked per weight kind (``enc_stack_*_stacked``
     [n_layer, ...]); use ``stack_weights_from_layers`` to map build()'s
-    per-layer weights onto them for parity checks."""
+    per-layer weights onto them for parity checks.
+
+    ``unroll``: layers per scan-loop iteration (chunked scan). 1 = max
+    compile-time savings; n_layer = full unroll inside the scan op
+    (near-build() step time, keeps the stacked-parameter layout)."""
     cfg = cfg or base()
     (src, trg, lbl, src_pad, trg_pad,
      enc_bias, dec_self_bias) = _train_feeds_and_biases()
@@ -761,7 +766,7 @@ def build_scan(cfg: Optional[TransformerConfig] = None,
 
     enc = _layer_scan(enc_in, cfg, _enc_weight_specs(cfg), enc_body,
                       "enc_stack", is_test,
-                      batch_vars=(enc_bias.name,))
+                      batch_vars=(enc_bias.name,), unroll=unroll)
     enc = _ln(enc, "enc_post")
 
     dec_in = _embed(trg, cfg.trg_vocab_size, cfg, "trg_emb.w", "trg_pos.w",
@@ -800,7 +805,7 @@ def build_scan(cfg: Optional[TransformerConfig] = None,
     dec = _layer_scan(dec_in, cfg, _dec_weight_specs(cfg), dec_body,
                       "dec_stack", is_test,
                       batch_vars=(dec_self_bias.name, enc_bias.name,
-                                  enc.name))
+                                  enc.name), unroll=unroll)
     dec = _ln(dec, "dec_post")
 
     logits, token_count, loss = _loss_head(dec, lbl, trg_pad, cfg)

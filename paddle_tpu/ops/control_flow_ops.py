@@ -191,8 +191,36 @@ def _scan(ins, attrs, rng=None):
         ys = tuple(env[n] for n in y_names)
         return new_carry, ys
 
+    # `unroll`: layers per loop iteration. unroll >= n_steps drops the
+    # scan machinery entirely — a static Python loop with STATIC slices
+    # of the stacked inputs, so no scan-transpose residual stacking and
+    # no dynamic-update-slices in the backward; this is the re-plumbed
+    # "unrolled build over stacked weights" path (measured: lax.scan
+    # unroll=1 0.216 MFU / full-unroll-inside-scan 0.341 / this path
+    # matches build() — BASELINE.md "scan-over-layers"). Intermediate
+    # unrolls measured SLOWER than unroll=1 (0.18-0.19) and are kept
+    # only for completeness.
+    unroll = int(attrs.get("unroll", 1))
+    if unroll >= int(n_steps):
+        order = range(int(n_steps))
+        if reverse:
+            order = reversed(order)
+        carry = tuple(init)
+        ys_steps = []
+        for i in order:
+            carry, ys_t = body(carry, (jnp.int32(i),
+                                       tuple(x[i] for x in xs)))
+            ys_steps.append(ys_t)
+        if reverse:
+            ys_steps.reverse()
+        ys = tuple(
+            jnp.stack([st[j] for st in ys_steps])
+            for j in range(len(y_names))
+        )
+        return {"Y": list(ys), "FinalState": list(carry)}
     steps = (jnp.arange(n_steps, dtype=jnp.int32), tuple(xs))
-    final, ys = lax.scan(body, tuple(init), steps, reverse=reverse)
+    final, ys = lax.scan(body, tuple(init), steps, reverse=reverse,
+                         unroll=max(1, unroll))
     return {"Y": list(ys), "FinalState": list(final)}
 
 

@@ -12,7 +12,12 @@ reference lacks (SURVEY.md section 5).
 """
 
 from paddle_tpu.parallel import checkpoint  # noqa: F401
-from paddle_tpu.parallel.mesh import create_mesh, get_mesh, set_mesh  # noqa: F401
+from paddle_tpu.parallel.mesh import (  # noqa: F401
+    create_mesh,
+    create_slice_mesh,
+    get_mesh,
+    set_mesh,
+)
 from paddle_tpu.parallel.strategy import (  # noqa: F401
     DistributedStrategy,
     ShardingRule,

@@ -48,6 +48,37 @@ def create_mesh(
     return mesh
 
 
+def create_slice_mesh(
+    n_slices: int,
+    within_axes: Dict[str, int],
+    slice_axis: str = "slice",
+    devices: Optional[Sequence] = None,
+    set_as_default: bool = True,
+) -> Mesh:
+    """Mesh with an OUTER cross-slice axis riding DCN and inner axes
+    riding ICI — the topology behind the reference's 2-level
+    hierarchical allreduce (reference: platform/nccl_helper.h:179-210).
+
+    On real multi-slice hardware the devices are ordered so each slice's
+    chips are contiguous (``jax.devices()`` groups by slice; for
+    irregular topologies use jax.experimental.mesh_utils'
+    ``create_hybrid_device_mesh`` and wrap the result in ``Mesh``
+    yourself). GSPMD then lowers a gradient all-reduce over
+    ``(slice, data)`` into within-slice reduce-scatter (ICI) +
+    cross-slice all-reduce (DCN) + within-slice all-gather
+    automatically — no hand-placed collectives.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    per_slice = int(np.prod(list(within_axes.values())))
+    if n_slices * per_slice != len(devs):
+        raise ValueError(
+            f"slice mesh ({n_slices} x {within_axes}) needs "
+            f"{n_slices * per_slice} devices, have {len(devs)}"
+        )
+    axes = {slice_axis: n_slices, **within_axes}
+    return create_mesh(axes, devices=devs, set_as_default=set_as_default)
+
+
 def set_mesh(mesh: Optional[Mesh]):
     global _current_mesh
     _current_mesh = mesh

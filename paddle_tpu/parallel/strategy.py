@@ -54,9 +54,43 @@ class DistributedStrategy:
         expert_axis: Optional[str] = None,
         pipe_axis: Optional[str] = None,
         pipe_micro: Optional[int] = None,
+        slice_axis: Optional[str] = None,
     ):
         self.mesh = mesh
         self.data_axis = data_axis if data_axis in mesh.axis_names else None
+        # Multi-slice data parallelism: an OUTER batch axis laid over DCN
+        # (slice boundaries), composing with the within-slice ICI data
+        # axis. The TPU-native equivalent of the reference's 2-level
+        # hierarchical allreduce (reference: platform/nccl_helper.h:179-210
+        # MultiNCCLContextMap inter/exter rings, parallel_executor.cc:180):
+        # with the batch sharded P((slice, data)), GSPMD decomposes the
+        # gradient all-reduce into within-slice reduce-scatter (ICI) +
+        # cross-slice all-reduce (DCN) + within-slice all-gather — the
+        # hierarchy comes from the mesh's device layout (see
+        # mesh.create_slice_mesh), not hand-inserted collectives.
+        self.slice_axis = (
+            slice_axis if slice_axis in mesh.axis_names else None
+        )
+        if self.slice_axis is not None:
+            clashing = [n for n, v in (("context_axis", context_axis),
+                                       ("pipe_axis", pipe_axis),
+                                       ("expert_axis", expert_axis),
+                                       ("table_axis", table_axis))
+                        if v is not None]
+            if clashing:
+                # Those axes route through explicit shard_map kernels
+                # (ring attention, GPipe, MoE all_to_all, sharded tables)
+                # whose batch specs name data_axis only; composing them
+                # with an outer slice axis would silently all-gather the
+                # batch across DCN per call. Fail loudly until the
+                # kernels' specs are slice-aware.
+                raise ValueError(
+                    f"slice_axis cannot yet be combined with "
+                    f"{clashing}: the shard_map kernels behind those "
+                    f"axes shard the batch over data_axis only. Use "
+                    f"slice_axis with plain data/tensor parallelism "
+                    f"(GSPMD paths)."
+                )
         self.rules = list(rules)
         self.strict = strict
         # Sequence/context parallelism: attention ops route through the
@@ -109,9 +143,12 @@ class DistributedStrategy:
         return NamedSharding(self.mesh, self.spec_for(name))
 
     def batch_sharding(self) -> NamedSharding:
-        if self.data_axis is None:
+        axes = tuple(a for a in (self.slice_axis, self.data_axis)
+                     if a is not None)
+        if not axes:
             return NamedSharding(self.mesh, P())
-        return NamedSharding(self.mesh, P(self.data_axis))
+        return NamedSharding(self.mesh, P(axes if len(axes) > 1
+                                          else axes[0]))
 
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
