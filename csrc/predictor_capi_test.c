@@ -1,0 +1,115 @@
+/* C test for the native predictor API (pt_predictor.h): load an
+ * exported zoo model, run a float batch read from a raw file, and check
+ * the outputs against an expected raw file within tolerance.
+ *
+ * Usage:
+ *   predictor_capi_test <model_dir> <input.bin> <rank> <d0> <d1> ...
+ *                       <input_name> <expected.bin>
+ * Exit 0 = outputs match. Pure C (compiled with -std=c99), linking only
+ * libpt_predictor — proves the ABI needs no C++/Python on the caller
+ * side. */
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "pt_predictor.h"
+
+static void* read_file(const char* path, long* size_out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return NULL;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  void* buf = malloc((size_t)sz);
+  if (fread(buf, 1, (size_t)sz, f) != (size_t)sz) {
+    fclose(f);
+    free(buf);
+    return NULL;
+  }
+  fclose(f);
+  if (size_out) *size_out = sz;
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 7) {
+    fprintf(stderr,
+            "usage: %s <model_dir> <input.bin> <rank> <dims...> "
+            "<input_name> <expected.bin>\n",
+            argv[0]);
+    return 2;
+  }
+  const char* model_dir = argv[1];
+  const char* input_path = argv[2];
+  int rank = atoi(argv[3]);
+  if (argc != 6 + rank) {
+    fprintf(stderr, "bad arg count for rank %d\n", rank);
+    return 2;
+  }
+  long long shapes[8];
+  long long numel = 1;
+  for (int i = 0; i < rank; ++i) {
+    shapes[i] = atoll(argv[4 + i]);
+    numel *= shapes[i];
+  }
+  const char* input_name = argv[4 + rank];
+  const char* expected_path = argv[5 + rank];
+
+  long in_size = 0, exp_size = 0;
+  float* input = (float*)read_file(input_path, &in_size);
+  float* expected = (float*)read_file(expected_path, &exp_size);
+  if (!input || !expected) {
+    fprintf(stderr, "cannot read input/expected files\n");
+    return 2;
+  }
+  if (in_size != numel * 4) {
+    fprintf(stderr, "input size %ld != %lld floats\n", in_size, numel * 4);
+    return 2;
+  }
+
+  pt_predictor* p = pt_predictor_create(model_dir);
+  if (!p) {
+    fprintf(stderr, "create failed: %s\n", pt_predictor_error());
+    return 1;
+  }
+  const char* names[1] = {input_name};
+  const void* data[1] = {input};
+  int dtypes[1] = {PT_DTYPE_FLOAT32};
+  int ranks[1] = {rank};
+  if (pt_predictor_run(p, 1, names, data, dtypes, ranks, shapes) != 0) {
+    fprintf(stderr, "run failed: %s\n", pt_predictor_error());
+    pt_predictor_destroy(p);
+    return 1;
+  }
+  if (pt_predictor_num_outputs(p) < 1) {
+    fprintf(stderr, "no outputs\n");
+    pt_predictor_destroy(p);
+    return 1;
+  }
+  long long out_n = 0;
+  const float* out = pt_predictor_output_data(p, 0, &out_n);
+  if (out_n * 4 != exp_size) {
+    fprintf(stderr, "output numel %lld != expected %ld bytes/4\n", out_n,
+            exp_size);
+    pt_predictor_destroy(p);
+    return 1;
+  }
+  double max_err = 0.0;
+  for (long long i = 0; i < out_n; ++i) {
+    double e = fabs((double)out[i] - (double)expected[i]);
+    if (e > max_err) max_err = e;
+  }
+  printf("outputs %d, first shape rank %d, numel %lld, max_err %g\n",
+         pt_predictor_num_outputs(p), pt_predictor_output_rank(p, 0), out_n,
+         max_err);
+  /* second run on the same handle must work (steady-state serving) */
+  if (pt_predictor_run(p, 1, names, data, dtypes, ranks, shapes) != 0) {
+    fprintf(stderr, "second run failed: %s\n", pt_predictor_error());
+    pt_predictor_destroy(p);
+    return 1;
+  }
+  pt_predictor_destroy(p);
+  free(input);
+  free(expected);
+  return max_err < 1e-4 ? 0 : 1;
+}

@@ -1,0 +1,60 @@
+/* Stable C inference API over the paddle_tpu Predictor.
+ *
+ * The native serving surface (reference:
+ * paddle/fluid/inference/api/api.cc + paddle_fluid.map symbol control —
+ * the reference exports a C/C++ predictor ABI usable from non-Python
+ * serving stacks). TPU-native design: the compute path is the same
+ * whole-program XLA executable the Python Predictor drives; this layer
+ * embeds CPython once per process (the standalone_trainer pattern,
+ * csrc/standalone_trainer.cc) and exposes a minimal stable ABI.
+ *
+ * Threading: calls must come from one thread (the embedded interpreter
+ * holds the GIL across calls). Output buffers are owned by the
+ * predictor and remain valid until the next pt_predictor_run or
+ * pt_predictor_destroy on the same handle.
+ */
+#ifndef PT_PREDICTOR_H_
+#define PT_PREDICTOR_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct pt_predictor pt_predictor;
+
+/* dtype codes for pt_predictor_run inputs */
+enum { PT_DTYPE_FLOAT32 = 0, PT_DTYPE_INT64 = 1, PT_DTYPE_INT32 = 2 };
+
+/* Load an inference model exported by
+ * paddle_tpu.io.save_inference_model. Returns NULL on failure (see
+ * pt_predictor_error). */
+pt_predictor* pt_predictor_create(const char* model_dir);
+
+/* Last error message for a NULL create or non-zero run (process-wide,
+ * not thread-safe). */
+const char* pt_predictor_error(void);
+
+void pt_predictor_destroy(pt_predictor* p);
+
+/* Run one batch. shapes = the n_inputs ranks' dims concatenated in
+ * order. data[i] points at ranks[i]-rank row-major data of dtypes[i].
+ * Returns 0 on success. */
+int pt_predictor_run(pt_predictor* p, int n_inputs,
+                     const char* const* names, const void* const* data,
+                     const int* dtypes, const int* ranks,
+                     const long long* shapes);
+
+int pt_predictor_num_outputs(pt_predictor* p);
+int pt_predictor_output_rank(pt_predictor* p, int i);
+/* dims pointer valid until the next run/destroy */
+const long long* pt_predictor_output_shape(pt_predictor* p, int i);
+/* Output values as float32 (outputs are converted); *numel receives the
+ * element count. Valid until the next run/destroy. */
+const float* pt_predictor_output_data(pt_predictor* p, int i,
+                                      long long* numel);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PT_PREDICTOR_H_ */
