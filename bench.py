@@ -185,6 +185,7 @@ def main():
             ("se_resnext", {"PT_BENCH_BATCH": "128"}),
             ("bert", {"PT_BENCH_BATCH": "64", "PT_BENCH_SEQ": "128"}),
             ("deepfm", {"PT_BENCH_BATCH": "4096"}),
+            ("ssd300", {"PT_BENCH_BATCH": "32"}),
         ):
             families[fam] = _rider(
                 [sys.executable, os.path.join(here, "bench_family.py")],
@@ -204,6 +205,7 @@ def main():
         "se_resnext50": families.get("se_resnext"),
         "bert_base": families.get("bert"),
         "deepfm": families.get("deepfm"),
+        "ssd300": families.get("ssd300"),
     }))
 
 

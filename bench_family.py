@@ -235,6 +235,59 @@ def main():
             "value_mean": round(eps_mean, 1),
         }))
 
+    elif FAMILY == "ssd300":
+        from paddle_tpu.models import ssd
+
+        batch = int(os.environ.get("PT_BENCH_BATCH", "32"))
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            model = ssd.get_ssd300_model(num_classes=21, gt_capacity=50)
+            fluid.optimizer.Momentum(0.001, momentum=0.9).minimize(
+                model["loss"])
+        main_prog._amp = True
+
+        def feed(b, s):
+            r = np.random.RandomState(s)
+            imgs = r.normal(0, 1, (b, 3, 300, 300)).astype(np.float32)
+            boxes = np.zeros((b, 50, 4), np.float32)
+            labels = np.zeros((b, 50), np.int64)
+            for i in range(b):
+                n_obj = r.randint(1, 12)
+                cx, cy = r.uniform(0.2, 0.8, (2, n_obj))
+                w, h = r.uniform(0.1, 0.5, (2, n_obj))
+                boxes[i, :n_obj, 0] = np.clip(cx - w / 2, 0, 1)
+                boxes[i, :n_obj, 1] = np.clip(cy - h / 2, 0, 1)
+                boxes[i, :n_obj, 2] = np.clip(cx + w / 2, 0, 1)
+                boxes[i, :n_obj, 3] = np.clip(cy + h / 2, 0, 1)
+                labels[i, :n_obj] = r.randint(1, 21, n_obj)
+            return {"image": imgs, "gt_box": boxes, "gt_label": labels}
+
+        def make_exe():
+            exe = fluid.Executor()
+            exe.run(startup)
+            return exe
+
+        try:
+            exe, batch = compile_with_oom_backoff(
+                make_exe, lambda e, b: e.run(main_prog, feed=feed(b, 0),
+                                             fetch_list=[model["loss"]]),
+                batch)
+        except AllBatchesOOM:
+            print(json.dumps({"metric": "ssd300_train_images_per_sec",
+                              "value": 0, "unit": "images/sec"}))
+            return
+        feeds = [{k: jax.device_put(v) for k, v in feed(batch, s).items()}
+                 for s in range(4)]
+        best, mean = run_windows(exe, main_prog, model["loss"], feeds,
+                                 steps)
+        ips, ips_mean = batch * steps / best, batch * steps / mean
+        log(f"images/sec={ips:.1f}")
+        print(json.dumps({
+            "metric": "ssd300_train_images_per_sec",
+            "value": round(ips, 1), "unit": "images/sec",
+            "value_mean": round(ips_mean, 1),
+        }))
+
     else:
         raise SystemExit(f"unknown PT_BENCH_FAMILY '{FAMILY}'")
 

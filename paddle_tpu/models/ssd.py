@@ -84,3 +84,68 @@ def synthetic_batch(batch_size=8, image_shape=(3, 64, 64), num_classes=7,
             imgs[i, :, int(y1 * hh):int(y2 * hh),
                  int(x1 * ww):int(x2 * ww)] += labels[i, j] / num_classes
     return {"image": imgs, "gt_box": boxes, "gt_label": labels}
+
+
+# --------------------------------------------------------------------------
+# SSD-300 (real scale): VGG16 backbone, 6 feature maps, 8732 priors
+# (reference architecture: Liu et al. 2016; reference API surface:
+# layers/detection.py multi_box_head/ssd_loss)
+# --------------------------------------------------------------------------
+
+
+def _vgg_block(x, filters, n, prefix):
+    for i in range(n):
+        x = layers.conv2d(x, filters, 3, padding=1, act="relu",
+                          name=f"{prefix}_{i}")
+    return x
+
+
+def ssd300_net(img, num_classes=21):
+    """VGG16-SSD300: maps at 38/19/10/5/3/1 -> 8732 priors."""
+    x = _vgg_block(img, 64, 2, "conv1")
+    x = layers.pool2d(x, 2, "max", 2)
+    x = _vgg_block(x, 128, 2, "conv2")
+    x = layers.pool2d(x, 2, "max", 2)
+    x = _vgg_block(x, 256, 3, "conv3")
+    x = layers.pool2d(x, 2, "max", 2, pool_padding=1)   # ceil: 38
+    conv4 = _vgg_block(x, 512, 3, "conv4")              # 38x38
+    x = layers.pool2d(conv4, 2, "max", 2)
+    x = _vgg_block(x, 512, 3, "conv5")
+    x = layers.pool2d(x, 3, "max", 1, pool_padding=1)
+    x = layers.conv2d(x, 1024, 3, padding=6, dilation=6, act="relu",
+                      name="fc6")                       # 19x19
+    fc7 = layers.conv2d(x, 1024, 1, act="relu", name="fc7")
+    x = layers.conv2d(fc7, 256, 1, act="relu", name="conv8_1")
+    conv8 = layers.conv2d(x, 512, 3, stride=2, padding=1, act="relu",
+                          name="conv8_2")               # 10x10
+    x = layers.conv2d(conv8, 128, 1, act="relu", name="conv9_1")
+    conv9 = layers.conv2d(x, 256, 3, stride=2, padding=1, act="relu",
+                          name="conv9_2")               # 5x5
+    x = layers.conv2d(conv9, 128, 1, act="relu", name="conv10_1")
+    conv10 = layers.conv2d(x, 256, 3, act="relu", name="conv10_2")  # 3x3
+    x = layers.conv2d(conv10, 128, 1, act="relu", name="conv11_1")
+    conv11 = layers.conv2d(x, 256, 3, act="relu", name="conv11_2")  # 1x1
+
+    maps = [conv4, fc7, conv8, conv9, conv10, conv11]
+    return detection.multi_box_head(
+        maps, img, base_size=300, num_classes=num_classes,
+        aspect_ratios=[[2.0], [2.0, 3.0], [2.0, 3.0], [2.0, 3.0],
+                       [2.0], [2.0]],
+        min_sizes=[30.0, 60.0, 111.0, 162.0, 213.0, 264.0],
+        max_sizes=[60.0, 111.0, 162.0, 213.0, 264.0, 315.0],
+        steps=[8.0, 16.0, 32.0, 64.0, 100.0, 300.0],
+        flip=True, clip=False)
+
+
+def get_ssd300_model(num_classes=21, gt_capacity=50):
+    """Real-scale SSD-300 training graph (8732 priors, VOC-sized class
+    count, 50-row dense-padded gt) — the load-scale validation of the
+    dense-padded detection design (BASELINE.md detection row)."""
+    img = layers.data("image", shape=[3, 300, 300], dtype="float32")
+    gt_box = layers.data("gt_box", shape=[gt_capacity, 4], dtype="float32")
+    gt_label = layers.data("gt_label", shape=[gt_capacity], dtype="int64")
+    locs, confs, boxes, variances = ssd300_net(img, num_classes)
+    loss = layers.mean(detection.ssd_loss(
+        locs, confs, gt_box, gt_label, boxes, variances))
+    return {"feeds": [img, gt_box, gt_label], "loss": loss,
+            "locs": locs, "confs": confs, "priors": boxes}

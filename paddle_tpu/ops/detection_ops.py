@@ -301,7 +301,18 @@ def _ssd_loss(ins, attrs):
             return col_match, dd
 
         col0 = jnp.full((p,), -1, jnp.int32)
-        col_match, _ = jax.lax.fori_loop(0, min(g, p), body, (col0, d))
+        # The greedy match is inherently sequential over gt rows; a
+        # device While at realistic scale (g=50, p=8732, b=32) measured
+        # 80 ms/step in per-iteration overhead alone (SSD-300 trace,
+        # BASELINE.md detection row), so small static trip counts unroll
+        # into straight-line code XLA fuses.
+        if min(g, p) <= 64:
+            state = (col0, d)
+            for _i in range(min(g, p)):
+                state = body(_i, state)
+            col_match, _ = state
+        else:
+            col_match, _ = jax.lax.fori_loop(0, min(g, p), body, (col0, d))
         if match_type == "per_prediction":
             # unmatched priors additionally match their best gt at or
             # above overlap_threshold (reference bipartite_match_op.cc
