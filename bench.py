@@ -149,7 +149,7 @@ def main():
             if isinstance(parsed, dict):
                 # strip the (null) nested rider keys a child bench.py emits
                 for k in ("resnet50", "long_context_t1024", "se_resnext50",
-                          "bert_base", "deepfm"):
+                          "bert_base", "deepfm", "ssd300"):
                     parsed.pop(k, None)
             return parsed
         except Exception as e:  # never let a rider kill the headline

@@ -1,6 +1,7 @@
 """Benchmark rider: SE-ResNeXt-50 / BERT-base / DeepFM on one TPU chip.
 
-One family per process (PT_BENCH_FAMILY in {se_resnext, bert, deepfm}):
+One family per process (PT_BENCH_FAMILY in {se_resnext, bert, deepfm,
+ssd300}):
 co-resident compiled programs contaminate each other's HBM/timing, so
 bench.py spawns this as a fresh subprocess per family, same as
 bench_resnet.py (methodology in BASELINE.md). Prints ONE JSON line.
@@ -24,6 +25,11 @@ Configs match the BASELINE.md target table:
   sort/unique path (46 ms/step) on one chip. The sparse path remains
   the multi-chip sharded-table capability (parallel/embedding.py);
   PT_BENCH_DEEPFM_SPARSE=1 benches it.
+- ssd300: real-scale detection — full VGG16-SSD300 (6 feature maps,
+  exactly 8732 priors, 21 classes, 50-row dense-padded gt) b=32 bf16
+  AMP + momentum. Metric is images/sec (no committed target; the row
+  validates the dense-padded detection design under load — BASELINE.md
+  "SSD-300 at realistic scale").
 """
 
 from __future__ import annotations

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.box_util import greedy_bipartite_match
 from paddle_tpu.ops.box_util import iou_xyxy as _iou_xyxy
 from paddle_tpu.ops.box_util import xyxy_area as _xyxy_area
 
@@ -290,29 +291,10 @@ def _ssd_loss(ins, attrs):
     iou = jnp.where(gt_valid[..., None], iou, -1.0)
 
     def match_one(d):
-        def body(_, state):
-            col_match, dd = state
-            idx = jnp.argmax(dd)
-            rr, cc = idx // p, idx % p
-            ok = dd[rr, cc] > 0
-            col_match = jnp.where(ok, col_match.at[cc].set(rr), col_match)
-            dd = jnp.where(ok, dd.at[rr, :].set(-1.0).at[:, cc].set(-1.0),
-                           dd)
-            return col_match, dd
-
-        col0 = jnp.full((p,), -1, jnp.int32)
-        # The greedy match is inherently sequential over gt rows; a
-        # device While at realistic scale (g=50, p=8732, b=32) measured
-        # 80 ms/step in per-iteration overhead alone (SSD-300 trace,
-        # BASELINE.md detection row), so small static trip counts unroll
-        # into straight-line code XLA fuses.
-        if min(g, p) <= 64:
-            state = (col0, d)
-            for _i in range(min(g, p)):
-                state = body(_i, state)
-            col_match, _ = state
-        else:
-            col_match, _ = jax.lax.fori_loop(0, min(g, p), body, (col0, d))
+        # shared greedy core (box_util.greedy_bipartite_match) keeps
+        # this fused path and the standalone bipartite_match op from
+        # drifting, and carries the static-unroll perf fix for both
+        col_match = greedy_bipartite_match(d)
         if match_type == "per_prediction":
             # unmatched priors additionally match their best gt at or
             # above overlap_threshold (reference bipartite_match_op.cc

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.registry import register_op
+from paddle_tpu.ops.box_util import greedy_bipartite_match
 
 
 def _x(ins, slot="X", i=0):
@@ -350,21 +351,10 @@ def _bipartite_match(ins, attrs):
             "ColToRowMatchDist": [outs["ColToRowMatchDist"][0][:, 0]],
         }
     m, n = dist.shape
-
-    def body(_, state):
-        col_match, d = state
-        idx = jnp.argmax(d)
-        r, c = idx // n, idx % n
-        ok = d[r, c] > 0
-        col_match = jnp.where(ok, col_match.at[c].set(r), col_match)
-        d = jnp.where(ok, d.at[r, :].set(-1.0).at[:, c].set(-1.0), d)
-        return col_match, d
-
     # Reference semantics (bipartite_match_op.cc): [1, n] per-COLUMN
-    # matched ROW indices.
-    col0 = jnp.full((n,), -1, jnp.int32)
-    col_match, _ = jax.lax.fori_loop(
-        0, min(m, n), body, (col0, dist.astype(jnp.float32)))
+    # matched ROW indices. Greedy core shared with the fused ssd_loss
+    # (box_util.greedy_bipartite_match, incl. the static-unroll fix).
+    col_match = greedy_bipartite_match(dist)
     if attrs.get("match_type") == "per_prediction":
         # unmatched columns additionally take their best row when the
         # overlap clears dist_threshold (bipartite_match_op.cc

@@ -44,17 +44,28 @@ def _run(amp, n_steps=4):
     return losses, scope, main, model
 
 
-def test_amp_loss_tracks_f32():
+import pytest
+
+
+@pytest.fixture(scope="module")
+def amp_run():
+    # one bf16 compile+run shared by the trajectory and master-weight
+    # tests (each _run costs a full transformer compile on the CPU
+    # backend)
+    return _run(amp=True)
+
+
+def test_amp_loss_tracks_f32(amp_run):
     f32, _, _, _ = _run(amp=False)
-    bf16, _, _, _ = _run(amp=True)
+    bf16, _, _, _ = amp_run
     assert all(np.isfinite(bf16)), bf16
     # same trajectory within bf16 noise
     np.testing.assert_allclose(f32, bf16, rtol=0.05, atol=0.05)
     assert bf16[-1] < bf16[0]  # still learning
 
 
-def test_amp_master_weights_stay_f32():
-    _, scope, main, _ = _run(amp=True, n_steps=2)
+def test_amp_master_weights_stay_f32(amp_run):
+    _, scope, main, _ = amp_run
     for p in main.all_parameters():
         v = scope.find_var(p.name)
         assert v is not None

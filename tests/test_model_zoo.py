@@ -19,10 +19,10 @@ def test_se_resnext50_trains_one_step():
         exe.run(startup)
         stem = "stem_conv.w"
         w0 = np.array(scope.find_var(stem))
-        for _ in range(2):
+        for _ in range(1):
             fd = {
-                "data": rng.randn(4, 3, 64, 64).astype(np.float32),
-                "label": rng.randint(0, 10, (4, 1)).astype(np.int64),
+                "data": rng.randn(2, 3, 48, 48).astype(np.float32),
+                "label": rng.randint(0, 10, (2, 1)).astype(np.int64),
             }
             (loss,) = exe.run(main, feed=fd, fetch_list=[model["loss"]])
             assert np.isfinite(loss).all()

@@ -72,7 +72,7 @@ def test_moe_capacity_overflow_identity_path():
 
 
 def test_moe_gradients_flow():
-    e, d, dh, n = 4, 8, 8, 16
+    e, d, dh, n = 2, 8, 8, 16
     mesh = Mesh(np.asarray(jax.devices()[:e]), ("expert",))
     gate_w, params = _setup(e, d, dh, seed=6)
     x = jnp.asarray(RS(7).normal(0, 1, (n, d)), jnp.float32)
@@ -107,10 +107,10 @@ def test_moe_trains_to_specialize():
         return jnp.mean((out - t) ** 2) + 0.01 * aux
 
     state = {"gate": gate_w, "params": params}
-    lr = 0.1
+    lr = 0.15
     l0 = float(loss(state))
     g = jax.jit(jax.grad(loss))
-    for _ in range(120):
+    for _ in range(60):
         grads = g(state)
         state = jax.tree.map(lambda p, gr: p - lr * gr, state, grads)
     l1 = float(loss(state))

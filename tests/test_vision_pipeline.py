@@ -35,7 +35,7 @@ def test_resnet50_imagenet_shape_trains_one_step():
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        model = resnet.get_model(data_shape=(3, 112, 112), class_dim=1000,
+        model = resnet.get_model(data_shape=(3, 96, 96), class_dim=1000,
                                  depth=50)
         fluid.optimizer.Momentum(0.1, momentum=0.9).minimize(model["loss"])
     main._amp = True
@@ -44,7 +44,7 @@ def test_resnet50_imagenet_shape_trains_one_step():
     with fluid.scope_guard(scope):
         exe.run(startup)
         r = np.random.RandomState(0)
-        fd = {"data": r.normal(0, 1, (2, 3, 112, 112)).astype(np.float32),
+        fd = {"data": r.normal(0, 1, (2, 3, 96, 96)).astype(np.float32),
               "label": r.randint(0, 1000, (2, 1)).astype(np.int64)}
         (loss,) = exe.run(main, feed=fd, fetch_list=[model["loss"]])
     assert np.isfinite(loss).all()
@@ -59,7 +59,7 @@ def test_resnet18_trains_and_grads_flow():
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        img = layers.data("data", shape=[3, 64, 64], dtype="float32")
+        img = layers.data("data", shape=[3, 48, 48], dtype="float32")
         label = layers.data("label", shape=[1], dtype="int64")
         logits = resnet.resnet_imagenet(img, class_dim=16, depth=18)
         loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
@@ -73,9 +73,9 @@ def test_resnet18_trains_and_grads_flow():
         stem = [p.name for p in main.all_parameters()
                 if p.shape and len(p.shape) == 4][0]
         w_before = np.array(scope.find_var(stem))
-        for step in range(4):
-            x = rng.uniform(-1, 1, (8, 3, 64, 64)).astype(np.float32)
-            y = rng.randint(0, 16, (8, 1)).astype(np.int64)
+        for step in range(3):
+            x = rng.uniform(-1, 1, (4, 3, 48, 48)).astype(np.float32)
+            y = rng.randint(0, 16, (4, 1)).astype(np.int64)
             (l,) = exe.run(main, feed={"data": x, "label": y},
                            fetch_list=[loss])
             losses.append(float(l))
