@@ -122,11 +122,20 @@ def spmd_ctx_scope(strategy):
         or getattr(strategy, "expert_axis", None)
         or getattr(strategy, "pipe_axis", None)
     ):
+        # Multi-slice: the batch axis kernels see is the COMPOSED
+        # (slice, data) tuple so shard_map specs and collective axis
+        # lists span both — the batch is sharded over their product
+        # (strategy.batch_sharding). Single-axis stays a plain string.
+        data_axis = strategy.data_axis
+        slice_axis = getattr(strategy, "slice_axis", None)
+        if slice_axis is not None:
+            data_axis = ((slice_axis, data_axis) if data_axis is not None
+                         else slice_axis)
         ctx = SpmdCtx(
             mesh=strategy.mesh,
             context_axis=strategy.context_axis,
             table_axis=strategy.table_axis,
-            data_axis=strategy.data_axis,
+            data_axis=data_axis,
             expert_axis=getattr(strategy, "expert_axis", None),
             pipe_axis=getattr(strategy, "pipe_axis", None),
             pipe_micro=getattr(strategy, "pipe_micro", None),

@@ -96,6 +96,15 @@ def _ring_config_t(q, k, drop, t_axis=2):
     if (n <= 1 or jnp.shape(q)[t_axis] % n != 0
             or jnp.shape(k)[t_axis] % n != 0):
         return None
+    # the batch dim must divide the (possibly composed slice x data)
+    # batch-axis ranks; replicate the batch rather than letting
+    # shard_map fail with an opaque uneven-sharding trace error
+    from paddle_tpu.parallel.mesh import axis_size
+
+    if data_axis is not None and (
+        jnp.shape(q)[0] % axis_size(mesh, data_axis) != 0
+    ):
+        data_axis = None
     return mesh, ctx_axis, data_axis
 
 

@@ -92,8 +92,10 @@ def _switch_moe(ins, attrs):
                 f"mesh size {mesh.shape[ctx.expert_axis]} but the op has "
                 f"{e} experts; they must match (one expert per rank)"
             )
+        from paddle_tpu.parallel.mesh import axis_size
+
         data_axis = ctx.data_axis
-        n_ranks = mesh.shape.get(data_axis, 1) if data_axis else 1
+        n_ranks = axis_size(mesh, data_axis) if data_axis else 1
         if data_axis is not None and n % n_ranks != 0:
             raise ValueError(
                 f"switch_moe: {n} tokens do not divide the data axis "

@@ -48,8 +48,14 @@ def sharded_embedding_lookup(
     Negative ids wrap (reference lookup_table_op.cc: negative = vocab+id),
     matching the dense path."""
     ids = jnp.where(ids < 0, ids + table.shape[0], ids)
-    d = data_axis if (data_axis in mesh.axis_names and
-                      jnp.shape(ids)[0] % mesh.shape[data_axis] == 0) else None
+    from paddle_tpu.parallel.mesh import axis_size, axis_tuple
+
+    d_axes = axis_tuple(data_axis)
+    d = None
+    if d_axes and all(a in mesh.axis_names for a in d_axes) and (
+        jnp.shape(ids)[0] % axis_size(mesh, d_axes) == 0
+    ):
+        d = data_axis
     ids_spec = P(d, *([None] * (jnp.ndim(ids) - 1)))
     out_spec = P(d, *([None] * jnp.ndim(ids)))
     fn = jax.shard_map(

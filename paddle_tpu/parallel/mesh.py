@@ -48,6 +48,25 @@ def create_mesh(
     return mesh
 
 
+def axis_tuple(axis) -> tuple:
+    """Normalize an axis spec (None | str | tuple of str) to a tuple.
+    Composed batch axes — the multi-slice (slice, data) pair — travel
+    through SpmdCtx as tuples; single axes stay strings."""
+    if axis is None:
+        return ()
+    if isinstance(axis, str):
+        return (axis,)
+    return tuple(axis)
+
+
+def axis_size(mesh: Mesh, axis) -> int:
+    """Total ranks across one axis or a composed tuple of axes."""
+    n = 1
+    for a in axis_tuple(axis):
+        n *= int(mesh.shape[a])
+    return n
+
+
 def create_slice_mesh(
     n_slices: int,
     within_axes: Dict[str, int],

@@ -93,7 +93,9 @@ def _moe_local(gate_w, expert_params, x, *, fn: Callable, axis: str,
     # The fractions are means over ALL tokens: pmean over the data axis too
     # when tokens are batch-sharded, else the aux (and its router gradient)
     # would be one data shard's local statistics.
-    axes = (axis,) if data_axis is None else (axis, data_axis)
+    from paddle_tpu.parallel.mesh import axis_tuple
+
+    axes = (axis,) + axis_tuple(data_axis)
     frac_tokens = jnp.mean(onehot.astype(x.dtype), axis=0)
     frac_probs = jnp.mean(probs, axis=0)
     aux = e * jnp.sum(lax.pmean(frac_tokens, axes) *
@@ -121,9 +123,14 @@ def moe_ffn(
     - ``fn(params_i, tokens) -> tokens`` the per-expert computation.
     Returns (combined [n, d], aux_loss scalar).
     """
+    from paddle_tpu.parallel.mesh import axis_size, axis_tuple
+
     e = mesh.shape[expert_axis]
     n = x.shape[0]
-    n_ranks = mesh.shape.get(data_axis, 1) if data_axis else 1
+    d_axes = axis_tuple(data_axis)
+    if d_axes and not all(a in mesh.axis_names for a in d_axes):
+        data_axis, d_axes = None, ()
+    n_ranks = axis_size(mesh, d_axes) if d_axes else 1
     n_loc = n // max(n_ranks, 1)
     if capacity is None:
         capacity = max(1, int(capacity_factor * n_loc / e))
@@ -136,7 +143,7 @@ def moe_ffn(
         params = jax.tree.map(lambda p: p[0], params)
         return _moe_local(
             gw, params, xs, fn=fn, axis=expert_axis, capacity=capacity,
-            data_axis=(data_axis if data_axis in mesh.axis_names else None),
+            data_axis=data_axis,
         )
 
     out, aux = jax.shard_map(

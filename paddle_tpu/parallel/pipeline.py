@@ -136,7 +136,9 @@ def gpipe(
     if b % n_micro != 0:
         raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
     if data_axis:
-        d = mesh.shape[data_axis]
+        from paddle_tpu.parallel.mesh import axis_size
+
+        d = axis_size(mesh, data_axis)
         if (b // n_micro) % d != 0:
             raise ValueError(
                 f"dp x pp: microbatch size {b // n_micro} "

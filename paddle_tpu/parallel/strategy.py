@@ -71,26 +71,11 @@ class DistributedStrategy:
         self.slice_axis = (
             slice_axis if slice_axis in mesh.axis_names else None
         )
-        if self.slice_axis is not None:
-            clashing = [n for n, v in (("context_axis", context_axis),
-                                       ("pipe_axis", pipe_axis),
-                                       ("expert_axis", expert_axis),
-                                       ("table_axis", table_axis))
-                        if v is not None]
-            if clashing:
-                # Those axes route through explicit shard_map kernels
-                # (ring attention, GPipe, MoE all_to_all, sharded tables)
-                # whose batch specs name data_axis only; composing them
-                # with an outer slice axis would silently all-gather the
-                # batch across DCN per call. Fail loudly until the
-                # kernels' specs are slice-aware.
-                raise ValueError(
-                    f"slice_axis cannot yet be combined with "
-                    f"{clashing}: the shard_map kernels behind those "
-                    f"axes shard the batch over data_axis only. Use "
-                    f"slice_axis with plain data/tensor parallelism "
-                    f"(GSPMD paths)."
-                )
+        # The shard_map kernels (ring attention, GPipe, MoE, sharded
+        # tables) receive the COMPOSED (slice, data) batch axis through
+        # SpmdCtx.data_axis (core/interp.py spmd_ctx_scope) — their
+        # specs/collectives accept axis tuples, so slice_axis composes
+        # with every other axis.
         self.rules = list(rules)
         self.strict = strict
         # Sequence/context parallelism: attention ops route through the

@@ -19,13 +19,12 @@ def test_se_resnext50_trains_one_step():
         exe.run(startup)
         stem = "stem_conv.w"
         w0 = np.array(scope.find_var(stem))
-        for _ in range(1):
-            fd = {
-                "data": rng.randn(2, 3, 48, 48).astype(np.float32),
-                "label": rng.randint(0, 10, (2, 1)).astype(np.int64),
-            }
-            (loss,) = exe.run(main, feed=fd, fetch_list=[model["loss"]])
-            assert np.isfinite(loss).all()
+        fd = {
+            "data": rng.randn(2, 3, 48, 48).astype(np.float32),
+            "label": rng.randint(0, 10, (2, 1)).astype(np.int64),
+        }
+        (loss,) = exe.run(main, feed=fd, fetch_list=[model["loss"]])
+        assert np.isfinite(loss).all()
         w1 = np.array(scope.find_var(stem))
     assert not np.allclose(w0, w1)  # grads reach the stem through SE gates
 

@@ -104,3 +104,88 @@ def test_slice2_within_dp2_tp2_composes():
         losses[mode] = cur
     np.testing.assert_allclose(losses["single"], losses["slice_dp_tp"],
                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_slice2_dp2_sp2_ring_attention_parity():
+    """slice x dp x sp-ring in one program: the shard_map ring-attention
+    kernel receives the COMPOSED (slice, data) batch axis through
+    SpmdCtx and stays parity-exact with the single-device run."""
+    from paddle_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(
+        src_vocab_size=100, trg_vocab_size=100, d_model=32, d_inner=64,
+        n_head=2, n_layer=1, max_length=40, dropout=0.0)
+    losses = {}
+    for mode in ("single", "slice_dp_sp"):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            model = T.build(cfg)
+            fluid.optimizer.SGD(0.05).minimize(model["loss"])
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            if mode == "single":
+                prog = main
+            else:
+                mesh = parallel.create_slice_mesh(
+                    2, {"data": 2, "sp": 2}, devices=jax.devices()[:8])
+                strategy = parallel.DistributedStrategy(
+                    mesh, data_axis="data", slice_axis="slice",
+                    context_axis="sp")
+                prog = fluid.CompiledProgram(main).with_strategy(strategy)
+            cur = []
+            for s in range(2):
+                fd = T.make_batch(cfg, batch=8, src_len=32, trg_len=32,
+                                  seed=s)
+                # ring attention shards the sequence axis evenly
+                fd["src_pad_mask"][:] = 1.0
+                fd["trg_pad_mask"][:] = 1.0
+                out = exe.run(prog, feed=fd, fetch_list=[model["loss"]])
+                cur.append(float(np.asarray(out[0])))
+        losses[mode] = cur
+    np.testing.assert_allclose(losses["single"], losses["slice_dp_sp"],
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_slice2_ep4_moe_parity():
+    """slice x ep: expert-parallel all_to_all dispatch with the batch
+    sharded over the outer slice axis; aux statistics pmean over the
+    composed axes keep router gradients global."""
+    losses = {}
+    for mode in ("single", "slice_ep"):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = layers.data("x", shape=[16], dtype="float32")
+            out_v, aux_v = layers.switch_moe(
+                xv, num_experts=4, d_ff=32, name="moe")
+            loss = layers.elementwise_add(
+                layers.mean(layers.square(out_v)),
+                layers.scale(aux_v, scale=0.01))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            if mode == "single":
+                prog = main
+            else:
+                from paddle_tpu.parallel.strategy import moe_rules
+
+                mesh = parallel.create_slice_mesh(
+                    2, {"expert": 4}, devices=jax.devices()[:8])
+                strategy = parallel.DistributedStrategy(
+                    mesh, data_axis=None, slice_axis="slice",
+                    rules=moe_rules("expert"), expert_axis="expert")
+                prog = fluid.CompiledProgram(main).with_strategy(strategy)
+            cur = []
+            for s in range(2):
+                fd = {"x": np.random.RandomState(s).normal(
+                    0, 1, (16, 16)).astype(np.float32)}
+                out = exe.run(prog, feed=fd, fetch_list=[loss])
+                cur.append(float(np.asarray(out[0])))
+        losses[mode] = cur
+    np.testing.assert_allclose(losses["single"], losses["slice_ep"],
+                               rtol=2e-4, atol=2e-4)
