@@ -4,6 +4,7 @@ from paddle_tpu.layers.io import *  # noqa: F401,F403
 from paddle_tpu.layers.nn import *  # noqa: F401,F403
 from paddle_tpu.layers.tensor import *  # noqa: F401,F403
 from paddle_tpu.layers.rnn import *  # noqa: F401,F403
+from paddle_tpu.layers.more import *  # noqa: F401,F403
 from paddle_tpu.layers import detection  # noqa: F401
 from paddle_tpu.layers.detection import *  # noqa: F401,F403
 from paddle_tpu.layers.control_flow import (  # noqa: F401

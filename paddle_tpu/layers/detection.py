@@ -24,22 +24,7 @@ __all__ = [
 ]
 
 
-def _op(op_type, inputs, attrs=None, out_slots=("Out",), dtypes=None,
-        name=None, stop_gradient=False):
-    helper = LayerHelper(op_type, name=name)
-    first = next(v for v in inputs.values() if v is not None)
-    base = first[0] if isinstance(first, (list, tuple)) else first
-    outs = {}
-    for i, s in enumerate(out_slots):
-        dt = (dtypes[i] if dtypes else None) or base.dtype
-        outs[s] = helper.create_variable_for_type_inference(
-            dtype=dt, stop_gradient=stop_gradient)
-    helper.append_op(op_type,
-                     inputs={k: v for k, v in inputs.items()
-                             if v is not None},
-                     outputs=outs, attrs=attrs or {})
-    vals = [outs[s] for s in out_slots]
-    return vals[0] if len(vals) == 1 else tuple(vals)
+from paddle_tpu.layer_helper import append_simple_op as _op  # noqa: E402
 
 
 def iou_similarity(x, y, name=None):

@@ -72,3 +72,14 @@ def _beam_search_step(ins, attrs):
         "Finished": [new_finished],
         "Parent": [parent.astype(jnp.int64)],
     }
+
+
+@register_op("beam_gather", no_grad=True)
+def _beam_gather(ins, attrs):
+    """Per-row beam selection: X [B, K, ...] gathered by Index [B] ->
+    [B, ...] (the final pick of beam_search_decode; reference:
+    beam_search_decode_op.cc selects the top sentence per source)."""
+    x = ins["X"][0]
+    idx = ins["Index"][0].astype(jnp.int32).reshape(-1)
+    return {"Out": [jnp.take_along_axis(
+        x, idx.reshape((-1,) + (1,) * (x.ndim - 1)), axis=1)[:, 0]]}

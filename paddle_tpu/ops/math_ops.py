@@ -523,3 +523,35 @@ def _cross_entropy2(ins, attrs):
 def _fill_zeros_like2(ins, attrs):
     x = _x(ins)
     return {"Out": [jnp.zeros_like(x)]}
+
+
+@register_op("reduce_all", no_grad=True)
+def _reduce_all(ins, attrs):
+    """Logical-AND reduction (reference: reduce_all_op.cc)."""
+    x = _x(ins)
+    dim = attrs.get("dim", None)
+    keep = bool(attrs.get("keep_dim", False))
+    axis = tuple(dim) if dim else None
+    return {"Out": [jnp.all(x.astype(bool), axis=axis, keepdims=keep)]}
+
+
+@register_op("reduce_any", no_grad=True)
+def _reduce_any(ins, attrs):
+    """Logical-OR reduction (reference: reduce_any_op.cc)."""
+    x = _x(ins)
+    dim = attrs.get("dim", None)
+    keep = bool(attrs.get("keep_dim", False))
+    axis = tuple(dim) if dim else None
+    return {"Out": [jnp.any(x.astype(bool), axis=axis, keepdims=keep)]}
+
+
+@register_op("has_inf", no_grad=True)
+def _has_inf(ins, attrs):
+    """Any +-inf present (reference: isinf_op)."""
+    return {"Out": [jnp.any(jnp.isinf(_x(ins)))]}
+
+
+@register_op("has_nan", no_grad=True)
+def _has_nan(ins, attrs):
+    """Any NaN present (reference: isnan_op)."""
+    return {"Out": [jnp.any(jnp.isnan(_x(ins)))]}

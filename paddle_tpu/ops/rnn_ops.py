@@ -230,22 +230,29 @@ def _lstmp(ins, attrs):
     b, t, d4 = x.shape
     d = d4 // 4
     p = w_proj.shape[1]
+    gate_act = _act(attrs.get("gate_activation", "sigmoid"))
+    cell_act = _act(attrs.get("cell_activation", "tanh"))
+    cand_act = _act(attrs.get("candidate_activation", "tanh"))
+    proj_act = _act(attrs.get("proj_activation", "tanh"))
+    reverse = bool(attrs.get("is_reverse", False))
 
     def step(carry, xt):
         h_p, c = carry
         gates = xt + h_p @ w
         if bias is not None:
             gates = gates + bias.reshape(-1)[:d4]
-        i = jax.nn.sigmoid(gates[:, :d])
-        f = jax.nn.sigmoid(gates[:, d:2 * d])
-        g = jnp.tanh(gates[:, 2 * d:3 * d])
-        o = jax.nn.sigmoid(gates[:, 3 * d:])
+        i = gate_act(gates[:, :d])
+        f = gate_act(gates[:, d:2 * d])
+        g = cand_act(gates[:, 2 * d:3 * d])
+        o = gate_act(gates[:, 3 * d:])
         c_new = f * c + i * g
-        h = o * jnp.tanh(c_new)
-        h_proj = h @ w_proj
-        return (h_proj, c_new), (h_proj, h)
+        h = o * cell_act(c_new)
+        h_proj = proj_act(h @ w_proj)
+        return (h_proj, c_new), (h_proj, c_new)
 
     h0 = jnp.zeros((b, p), x.dtype)
     c0 = jnp.zeros((b, d), x.dtype)
-    (_, _), (hs, _) = jax.lax.scan(step, (h0, c0), x.transpose(1, 0, 2))
-    return {"Projection": [hs.transpose(1, 0, 2)]}
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), x.transpose(1, 0, 2),
+                                    reverse=reverse)
+    return {"Projection": [hs.transpose(1, 0, 2)],
+            "Cell": [cs.transpose(1, 0, 2)]}

@@ -830,3 +830,37 @@ def _roi_perspective_transform(ins, attrs):
 
     out = jax.vmap(one)(bidx, quads)
     return {"Out": [out.astype(_x(ins).dtype)]}
+
+
+def _adaptive_pool(x, out_sizes, ptype, spatial):
+    """Exact adaptive pooling (reference: pool_op.cc adaptive=True):
+    cell i covers [floor(i*L/o), ceil((i+1)*L/o)). Output sizes are
+    static, so the cell loop unrolls into slices XLA fuses."""
+    in_sizes = x.shape[-spatial:]
+    out = x
+    for d in range(spatial):
+        L, o = in_sizes[d], int(out_sizes[d])
+        axis = x.ndim - spatial + d
+        cells = []
+        for i in range(o):
+            lo = (i * L) // o
+            hi = -(-((i + 1) * L) // o)  # ceil
+            seg = jax.lax.slice_in_dim(out, lo, hi, axis=axis)
+            if ptype == "max":
+                cells.append(jnp.max(seg, axis=axis, keepdims=True))
+            else:
+                cells.append(jnp.mean(seg, axis=axis, keepdims=True))
+        out = jnp.concatenate(cells, axis=axis)
+    return out
+
+
+@register_op("adaptive_pool2d", diff_inputs=("X",))
+def _adaptive_pool2d(ins, attrs):
+    return {"Out": [_adaptive_pool(
+        _x(ins), attrs["ksize"], attrs.get("pooling_type", "max"), 2)]}
+
+
+@register_op("adaptive_pool3d", diff_inputs=("X",))
+def _adaptive_pool3d(ins, attrs):
+    return {"Out": [_adaptive_pool(
+        _x(ins), attrs["ksize"], attrs.get("pooling_type", "max"), 3)]}

@@ -1,0 +1,234 @@
+"""Layer-API parity tail (layers/more.py): one big program exercising
+the wrappers in a single compile (suite-time budget), plus semantic
+spot checks against numpy."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def test_more_layers_one_program():
+    main, startup = fluid.Program(), fluid.Program()
+    B = 4
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[B, 6], append_batch_size=False,
+                        stop_gradient=True)
+        img = layers.data("img", shape=[B, 4, 8, 8], append_batch_size=False,
+                          stop_gradient=True)
+        seq = layers.data("seq", shape=[B, 5, 3], append_batch_size=False,
+                          stop_gradient=True)
+        length = layers.data("length", shape=[B], dtype="int64",
+                             append_batch_size=False)
+        lbl = layers.data("lbl", shape=[B, 1], dtype="int64",
+                          append_batch_size=False)
+
+        fetches = {}
+        fetches["brelu"] = layers.brelu(x, 0.0, 1.0)
+        fetches["soft_relu"] = layers.soft_relu(x)
+        fetches["stanh"] = layers.stanh(x)
+        fetches["selu"] = layers.selu(x)
+        fetches["sign"] = layers.sign(x)
+        fetches["cos_sim"] = layers.cos_sim(x, x)
+        fetches["reduce_all"] = layers.reduce_all(
+            layers.greater_equal(x, layers.scale(x, scale=1.0)))
+        fetches["reduce_any"] = layers.reduce_any(
+            layers.not_equal(x, layers.scale(x, scale=0.0)))
+        fetches["isfinite"] = layers.isfinite(x)
+        fetches["has_inf"] = layers.has_inf(x)
+        fetches["has_nan"] = layers.has_nan(x)
+        fetches["reverse"] = layers.reverse(x, axis=1)
+        out_sorted, idx = layers.argsort(x, axis=1)
+        fetches["argsort"] = out_sorted
+        fetches["diag"] = layers.diag(
+            layers.reshape(layers.slice(x, axes=[0], starts=[0], ends=[1]),
+                           [6]))
+        fetches["rank"] = layers.rank(x)
+
+        probs = layers.softmax(x)
+        fetches["bpr_loss"] = layers.bpr_loss(x, lbl)
+        fetches["dice_loss"] = layers.dice_loss(probs, lbl)
+        fetches["kldiv"] = layers.kldiv_loss(x, probs)
+        fetches["log_loss"] = layers.log_loss(
+            layers.sigmoid(layers.slice(x, axes=[1], starts=[0], ends=[1])),
+            layers.cast(lbl, "float32"))
+        half = layers.slice(x, axes=[1], starts=[0], ends=[1])
+        other = layers.slice(x, axes=[1], starts=[1], ends=[2])
+        fetches["margin_rank"] = layers.margin_rank_loss(
+            layers.cast(lbl, "float32"), half, other)
+        fetches["rank_loss"] = layers.rank_loss(
+            layers.cast(lbl, "float32"), half, other)
+        fetches["npair"] = layers.npair_loss(x, x, lbl)
+        fetches["ts_loss"] = layers.teacher_student_sigmoid_loss(
+            half, layers.cast(lbl, "float32"))
+
+        fetches["apool2d"] = layers.adaptive_pool2d(img, [3, 2], "avg")
+        fetches["pad2d"] = layers.pad2d(img, [1, 1, 2, 2])
+        fetches["crop"] = layers.crop(img, shape=[B, 4, 4, 4],
+                                      offsets=[0, 0, 1, 1])
+        fetches["pixshuf"] = layers.pixel_shuffle(img, 2)
+        fetches["shufch"] = layers.shuffle_channel(img, 2)
+        fetches["s2d"] = layers.space_to_depth(img, 2)
+        fetches["tshift"] = layers.temporal_shift(img, seg_num=2)
+        ch_scale = layers.fill_constant(shape=[4], dtype="float32",
+                                        value=2.0)
+        ch_bias = layers.fill_constant(shape=[4], dtype="float32",
+                                       value=0.5)
+        fetches["affch"] = layers.affine_channel(img, ch_scale, ch_bias)
+        fetches["resize"] = layers.resize_bilinear(img, out_shape=[4, 4])
+        fetches["resize_n"] = layers.resize_nearest(img, out_shape=[4, 4])
+        fetches["resize_s"] = layers.image_resize_short(img, 4)
+        fetches["fsp"] = layers.fsp_matrix(img, img)
+
+        fetches["seq_first"] = layers.sequence_first_step(seq, length)
+        fetches["seq_last"] = layers.sequence_last_step(seq, length)
+        fetches["seq_rev"] = layers.sequence_reverse(seq)
+        fetches["seq_reshape"] = layers.sequence_reshape(seq, 15)
+        fetches["seq_enum"] = layers.sequence_enumerate(
+            layers.cast(layers.reduce_sum(seq, dim=2), "int64"),
+            win_size=2)
+
+        fetches["fill_bsl"] = layers.fill_constant_batch_size_like(
+            x, [0, 7], "float32", 3.5)
+        fetches["uniform_bsl"] = layers.uniform_random_batch_size_like(
+            x, [0, 3])
+        fetches["counter"] = layers.autoincreased_step_counter()
+        fetches["lod_reset"] = layers.lod_reset(x)
+
+        arr = layers.create_array("float32", 4, template=x)
+        i0 = layers.fill_constant(shape=[], dtype="int64", value=1)
+        arr = layers.array_write(x, i0, arr)
+        fetches["arr_read"] = layers.array_read(arr, i0)
+        fetches["arr_len"] = layers.array_length(arr)
+
+        h0 = layers.fill_constant(shape=[B, 4], dtype="float32", value=0.0)
+        c0 = layers.fill_constant(shape=[B, 4], dtype="float32", value=0.0)
+        h1, c1 = layers.lstm_unit(x, h0, c0)
+        fetches["lstm_unit"] = h1
+        xg = layers.fc(x, 12)
+        hh, _r, _g = layers.gru_unit(xg, h0, 12)
+        fetches["gru_unit"] = hh
+        xi = layers.fc(x, 16)
+        proj, cell = layers.dynamic_lstmp(
+            layers.expand(layers.unsqueeze(xi, [1]), [1, 5, 1]),
+            size=16, proj_size=6)
+        fetches["lstmp"] = proj
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    r = np.random.RandomState(0)
+    feed = {
+        "x": r.randn(B, 6).astype(np.float32),
+        "img": r.randn(B, 4, 8, 8).astype(np.float32),
+        "seq": r.randn(B, 5, 3).astype(np.float32),
+        "length": np.array([5, 3, 1, 4], np.int64),
+        "lbl": r.randint(0, 2, (B, 1)).astype(np.int64),
+    }
+    names = list(fetches)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        outs = exe.run(main, feed=feed,
+                       fetch_list=[fetches[n] for n in names])
+    got = dict(zip(names, [np.asarray(o) for o in outs]))
+
+    xv = feed["x"]
+    np.testing.assert_allclose(got["brelu"], np.clip(xv, 0, 1), rtol=1e-6)
+    np.testing.assert_allclose(got["sign"], np.sign(xv), rtol=1e-6)
+    np.testing.assert_allclose(got["cos_sim"].ravel(), np.ones(B), rtol=1e-5)
+    assert bool(got["reduce_all"]) and bool(got["isfinite"])
+    assert not bool(got["has_inf"]) and not bool(got["has_nan"])
+    np.testing.assert_allclose(got["reverse"], xv[:, ::-1], rtol=1e-6)
+    np.testing.assert_allclose(got["argsort"], np.sort(xv, 1), rtol=1e-6)
+    assert got["rank"].ravel()[0] == 2
+    assert got["apool2d"].shape == (B, 4, 3, 2)
+    # exact adaptive-avg check on one cell: rows [0:3) x cols [0:4)
+    np.testing.assert_allclose(
+        got["apool2d"][:, :, 0, 0], feed["img"][:, :, 0:3, 0:4].mean(
+            axis=(2, 3)), rtol=1e-5)
+    assert got["pad2d"].shape == (B, 4, 10, 12)
+    assert got["crop"].shape == (B, 4, 4, 4)
+    assert got["pixshuf"].shape == (B, 1, 16, 16)
+    assert got["s2d"].shape == (B, 16, 4, 4)
+    assert got["resize"].shape == (B, 4, 4, 4)
+    assert got["resize_s"].shape == (B, 4, 4, 4)
+    assert got["fsp"].shape == (B, 4, 4)
+    # first/last step respect the per-row lengths
+    np.testing.assert_allclose(got["seq_first"], feed["seq"][:, 0],
+                               rtol=1e-6)
+    expect_last = np.stack([feed["seq"][b, l - 1]
+                            for b, l in enumerate(feed["length"])])
+    np.testing.assert_allclose(got["seq_last"], expect_last, rtol=1e-6)
+    assert got["fill_bsl"].shape == (B, 7) and got["fill_bsl"][0, 0] == 3.5
+    assert got["uniform_bsl"].shape == (B, 3)
+    assert got["counter"].ravel()[0] == 1
+    np.testing.assert_allclose(got["arr_read"], xv, rtol=1e-6)
+    assert got["arr_len"].ravel()[0] == 4
+    assert got["lstm_unit"].shape == (B, 4)  # x [B,6] isn't 4*4: see below
+    for k, v in got.items():
+        assert np.isfinite(v.astype(np.float64)).all() or v.dtype == bool, k
+
+
+def test_beam_search_layer_roundtrip():
+    B, K, T, V = 2, 3, 4, 7
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[B, K, T], dtype="int64",
+                          append_batch_size=False)
+        scores = layers.data("scores", shape=[B, K], dtype="float32",
+                             append_batch_size=False)
+        logp = layers.data("logp", shape=[B, K, V], dtype="float32",
+                           append_batch_size=False)
+        fin = layers.data("fin", shape=[B, K], dtype="bool",
+                          append_batch_size=False)
+        step = layers.fill_constant(shape=[], dtype="int64", value=1)
+        nids, nscores, nfin = layers.beam_search(
+            ids, scores, None, None, beam_size=K, end_id=V - 1,
+            log_probs=logp, finished=fin, step_idx=step)
+        # reference-style call with default finished/step_idx
+        dids, dscores, dfin = layers.beam_search(
+            ids, scores, None, logp, beam_size=K, end_id=V - 1)
+        best_ids, best_scores = layers.beam_search_decode(nids, nscores)
+    exe = fluid.Executor(fluid.CPUPlace())
+    r = np.random.RandomState(3)
+    feed = {
+        "ids": r.randint(0, V, (B, K, T)).astype(np.int64),
+        "scores": r.randn(B, K).astype(np.float32),
+        "logp": np.log(r.dirichlet(np.ones(V), (B, K)).astype(np.float32)),
+        "fin": np.zeros((B, K), bool),
+    }
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out = exe.run(main, feed=feed,
+                      fetch_list=[best_ids, best_scores, nscores, dscores])
+    bi, bs, ns, ds = [np.asarray(o) for o in out]
+    assert ds.shape == (B, K) and np.isfinite(ds).all()
+    assert bi.shape == (B, T) and bs.shape == (B,)
+    # the decoded score is the max over beams
+    np.testing.assert_allclose(bs, np.asarray(ns).max(axis=1), rtol=1e-6)
+
+
+def test_lstm_layer_and_tensor_array_to_tensor():
+    B, T, D, H = 2, 5, 6, 8
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[B, T, D], append_batch_size=False,
+                        stop_gradient=True)
+        h0 = layers.fill_constant(shape=[1, B, H], dtype="float32",
+                                  value=0.0)
+        out, last_h, last_c = layers.lstm(x, h0, h0, T, H, num_layers=2,
+                                          is_bidirec=True)
+        arr = layers.create_array("float32", 3, template=x)
+        t_out, sizes = layers.tensor_array_to_tensor(arr, axis=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    r = np.random.RandomState(1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        o, lh, lc, ta = [np.asarray(v) for v in exe.run(
+            main, feed={"x": r.randn(B, T, D).astype(np.float32)},
+            fetch_list=[out, last_h, last_c, t_out])]
+    assert o.shape == (B, T, 2 * H)
+    assert lh.shape == (B, H) and lc.shape == (B, H)
+    assert np.isfinite(o).all()
+    assert ta.shape == (B, 3, T, D)
