@@ -587,3 +587,252 @@ class Dropout(Layer):
             ),
             "Out",
         )
+
+
+class Conv3D(Layer):
+    """reference: dygraph/nn.py Conv3D (operators/conv_op.cc 3-D)."""
+
+    def __init__(self, name_scope, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        trip = lambda v: (tuple(v) if isinstance(v, (list, tuple))
+                          else (v,) * 3)
+        self._num_filters = num_filters
+        self._filter_size = trip(filter_size)
+        self._stride = trip(stride)
+        self._padding = trip(padding)
+        self._dilation = trip(dilation)
+        self._groups = groups or 1
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._filter = None
+        self._bias = None
+
+    def _build_once(self, x):
+        cin = x.shape[1]
+        self._filter = self.create_parameter(
+            self._param_attr,
+            [self._num_filters, cin // self._groups] + list(
+                self._filter_size), self._dtype)
+        self._bias = self.create_parameter(
+            self._bias_attr, [self._num_filters], self._dtype, is_bias=True)
+
+    _OP = "conv3d"
+
+    def forward(self, x):
+        if self._filter is None:
+            self._build_once(x)
+        outs = self._trace(
+            self._OP, {"Input": [x], "Filter": [self._filter]},
+            {"strides": list(self._stride), "paddings": list(self._padding),
+             "dilations": list(self._dilation), "groups": self._groups})
+        y = _first(outs, "Output")
+        if self._bias is not None:
+            y = _first(self._trace("elementwise_add",
+                                   {"X": [y], "Y": [self._bias]},
+                                   {"axis": 1}), "Out")
+        if self._act:
+            y = _first(self._trace(self._act, {"X": [y]}, {}), "Out")
+        return y
+
+
+class Conv3DTranspose(Conv3D):
+    """reference: dygraph/nn.py Conv3DTranspose."""
+
+    _OP = "conv3d_transpose"
+
+    def _build_once(self, x):
+        cin = x.shape[1]
+        self._filter = self.create_parameter(
+            self._param_attr,
+            [cin, self._num_filters // self._groups] + list(
+                self._filter_size), self._dtype)
+        self._bias = self.create_parameter(
+            self._bias_attr, [self._num_filters], self._dtype, is_bias=True)
+
+
+class NCE(Layer):
+    """reference: dygraph/nn.py NCE (operators/nce_op.cc)."""
+
+    def __init__(self, name_scope, num_total_classes, param_attr=None,
+                 bias_attr=None, num_neg_samples=10, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_total_classes = num_total_classes
+        self._num_neg = num_neg_samples
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._w = None
+        self._b = None
+
+    def forward(self, input, label):
+        if self._w is None:
+            d = input.shape[-1]
+            self._w = self.create_parameter(
+                self._param_attr, [self._num_total_classes, d], self._dtype)
+            self._b = self.create_parameter(
+                self._bias_attr, [self._num_total_classes], self._dtype,
+                is_bias=True)
+        ins = {"Input": [input], "Label": [label], "Weight": [self._w]}
+        if self._b is not None:
+            ins["Bias"] = [self._b]
+        return _first(self._trace(
+            "nce", ins, {"num_neg_samples": self._num_neg}), "Cost")
+
+
+class BilinearTensorProduct(Layer):
+    """reference: dygraph/nn.py BilinearTensorProduct."""
+
+    def __init__(self, name_scope, size, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self._w = None
+        self._b = None
+
+    def forward(self, x, y):
+        if self._w is None:
+            self._w = self.create_parameter(
+                self._param_attr,
+                [self._size, x.shape[-1], y.shape[-1]], self._dtype)
+            self._b = self.create_parameter(
+                self._bias_attr, [self._size], self._dtype, is_bias=True)
+        ins = {"X": [x], "Y": [y], "Weight": [self._w]}
+        if self._b is not None:
+            ins["Bias"] = [self._b]
+        out = _first(self._trace("bilinear_tensor_product", ins, {}), "Out")
+        if self._act:
+            out = _first(self._trace(self._act, {"X": [out]}, {}), "Out")
+        return out
+
+
+class SequenceConv(Layer):
+    """reference: dygraph/nn.py SequenceConv (context-window conv over
+    padded [b, t, d] batches — the dense LoD redesign)."""
+
+    def __init__(self, name_scope, num_filters, filter_size=3,
+                 filter_stride=1, padding=None, bias_attr=None,
+                 param_attr=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._num_filters = num_filters
+        self._filter_size = filter_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self._filter = None
+        self._bias = None
+
+    def forward(self, x):
+        if self._filter is None:
+            d = x.shape[-1]
+            self._filter = self.create_parameter(
+                self._param_attr, [self._filter_size * d,
+                                   self._num_filters], self._dtype)
+            self._bias = self.create_parameter(
+                self._bias_attr, [self._num_filters], self._dtype,
+                is_bias=True)
+        outs = self._trace(
+            "sequence_conv", {"X": [x], "Filter": [self._filter]},
+            {"contextLength": self._filter_size, "contextStart":
+             -(self._filter_size // 2), "contextStride": 1})
+        y = _first(outs, "Out")
+        if self._bias is not None:
+            y = _first(self._trace("elementwise_add",
+                                   {"X": [y], "Y": [self._bias]},
+                                   {"axis": 2}), "Out")
+        if self._act:
+            y = _first(self._trace(self._act, {"X": [y]}, {}), "Out")
+        return y
+
+
+class RowConv(Layer):
+    """reference: dygraph/nn.py RowConv (operators/row_conv_op.cc)."""
+
+    def __init__(self, name_scope, future_context_size, param_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._future = future_context_size
+        self._param_attr = param_attr
+        self._act = act
+        self._filter = None
+
+    def forward(self, x):
+        if self._filter is None:
+            self._filter = self.create_parameter(
+                self._param_attr, [self._future + 1, x.shape[-1]],
+                self._dtype)
+        y = _first(self._trace(
+            "row_conv", {"X": [x], "Filter": [self._filter]}, {}), "Out")
+        if self._act:
+            y = _first(self._trace(self._act, {"X": [y]}, {}), "Out")
+        return y
+
+
+class SpectralNorm(Layer):
+    """reference: dygraph/nn.py SpectralNorm (operators/spectral_norm_op.cc).
+    The power-iteration vectors persist as non-trainable state, as the
+    static path does."""
+
+    def __init__(self, name_scope, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        self._u = None
+        self._v = None
+
+    def forward(self, weight):
+        if self._u is None:
+            h = weight.shape[self._dim]
+            w = 1
+            for i, s in enumerate(weight.shape):
+                if i != self._dim:
+                    w *= s
+            self._u = self.create_parameter(
+                None, [h], self._dtype,
+                default_initializer=NormalInitializer(0.0, 1.0))
+            self._v = self.create_parameter(
+                None, [w], self._dtype,
+                default_initializer=NormalInitializer(0.0, 1.0))
+        outs = self._trace(
+            "spectral_norm",
+            {"Weight": [weight], "U": [self._u], "V": [self._v]},
+            {"dim": self._dim, "power_iters": self._power_iters,
+             "eps": self._eps})
+        return _first(outs, "Out")
+
+
+class TreeConv(Layer):
+    """reference: dygraph/nn.py TreeConv (operators/tree_conv_op.cc)."""
+
+    def __init__(self, name_scope, output_size, num_filters=1, max_depth=2,
+                 act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._max_depth = max_depth
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._w = None
+
+    def forward(self, nodes_vector, edge_set):
+        if self._w is None:
+            f = nodes_vector.shape[2]
+            self._w = self.create_parameter(
+                self._param_attr,
+                [f, 3, self._output_size, self._num_filters], self._dtype)
+        out = _first(self._trace(
+            "tree_conv",
+            {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+             "Filter": [self._w]},
+            {"max_depth": self._max_depth}), "Out")
+        if self._act:
+            out = _first(self._trace(self._act, {"X": [out]}, {}), "Out")
+        return out

@@ -12,8 +12,6 @@ from __future__ import annotations
 
 import builtins
 
-from typing import Optional, Sequence
-
 from paddle_tpu.framework import Variable
 from paddle_tpu.layer_helper import LayerHelper
 from paddle_tpu.param_attr import ParamAttr
@@ -444,7 +442,7 @@ def image_resize(input, out_shape=None, scale=None, name=None,
           "NEAREST": "nearest_interp"}.get(resample.upper())
     if op is None:
         raise ValueError(f"image_resize: unsupported resample {resample}")
-    attrs = {"align_corners": align_corners}
+    attrs = {"align_corners": align_corners, "align_mode": align_mode}
     if scale:
         attrs["scale"] = float(scale)
     if out_shape is not None:
@@ -461,7 +459,7 @@ def resize_bilinear(input, out_shape=None, scale=None, name=None,
 def resize_nearest(input, out_shape=None, scale=None, name=None,
                    actual_shape=None, align_corners=True):
     return image_resize(input, out_shape, scale, name, "NEAREST",
-                        actual_shape, align_corners)
+                        actual_shape, align_corners, align_mode=1)
 
 
 def image_resize_short(input, out_short_len, resample="BILINEAR"):
@@ -497,8 +495,31 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
     helper = LayerHelper("conv3d_transpose", name=name,
                          bias_attr=bias_attr, act=act)
     c_in = int(input.shape[1])
-    fs = (list(filter_size) if isinstance(filter_size, (list, tuple))
-          else [filter_size] * 3)
+
+    def _trip0(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError(
+                "conv3d_transpose needs filter_size or output_size")
+        # derive the filter from the requested output extent (reference:
+        # conv_transpose layer): k = out - (in-1)*s + 2p - ... solved per
+        # dim for dilation 1
+        outs3 = _trip0(output_size)
+        st3, pd3, dl3 = _trip0(stride), _trip0(padding), _trip0(dilation)
+        fs = []
+        for i in range(3):
+            k = (outs3[i] - (int(input.shape[2 + i]) - 1) * st3[i]
+                 + 2 * pd3[i] - 1) // dl3[i] + 1
+            fs.append(int(k))
+    else:
+        fs = _trip0(filter_size)
+        if output_size is not None:
+            raise ValueError(
+                "conv3d_transpose: pass filter_size OR output_size, "
+                "not both (static-shape design derives one from the "
+                "other)")
     g = groups or 1
     w = helper.create_parameter(
         ParamAttr._to_attr(param_attr), [c_in, num_filters // g] + fs,
@@ -571,10 +592,6 @@ def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
 # --------------------------------------------------------------------------
 
 
-def _seq_op(op_type, ins, attrs=None, out_slots=("Out",), dtypes=None):
-    return _op(op_type, ins, attrs, out_slots=out_slots, dtypes=dtypes)
-
-
 def sequence_concat(input, name=None):
     """Concatenate along TIME (reference: sequence_concat_op.cc); dense
     design concatenates the padded time axes."""
@@ -586,26 +603,26 @@ def sequence_concat(input, name=None):
 
 
 def sequence_enumerate(input, win_size, pad_value=0, name=None):
-    return _seq_op("sequence_enumerate", {"X": input},
+    return _op("sequence_enumerate", {"X": input},
                    {"win_size": win_size, "pad_value": pad_value})
 
 
 def sequence_expand_as(x, y, name=None):
-    return _seq_op("sequence_expand_as", {"X": x, "Y": y})
+    return _op("sequence_expand_as", {"X": x, "Y": y})
 
 
 def sequence_first_step(input, length=None):
     ins = {"X": input}
     if length is not None:
         ins["Length"] = length
-    return _seq_op("sequence_pool", ins, {"pooltype": "FIRST"})
+    return _op("sequence_pool", ins, {"pooltype": "FIRST"})
 
 
 def sequence_last_step(input, length=None):
     ins = {"X": input}
     if length is not None:
         ins["Length"] = length
-    return _seq_op("sequence_pool", ins, {"pooltype": "LAST"})
+    return _op("sequence_pool", ins, {"pooltype": "LAST"})
 
 
 def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
@@ -614,25 +631,26 @@ def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
         ins["Length"] = length
     out, out_len = _op("sequence_pad", ins,
                        {"padded_length": maxlen or -1},
-                       out_slots=("Out", "Length"), dtypes=(None, "int64"))
+                       out_slots=("Out", "OutLength"),
+                       dtypes=(None, "int64"))
     return out, out_len
 
 
 def sequence_unpad(x, length, name=None):
-    return _seq_op("sequence_unpad", {"X": x, "Length": length})
+    return _op("sequence_unpad", {"X": x, "Length": length})
 
 
 def sequence_reshape(input, new_dim):
-    return _seq_op("sequence_reshape", {"X": input}, {"new_dim": new_dim})
+    return _op("sequence_reshape", {"X": input}, {"new_dim": new_dim})
 
 
 def sequence_scatter(input, index, updates, name=None):
-    return _seq_op("sequence_scatter",
+    return _op("sequence_scatter",
                    {"X": input, "Ids": index, "Updates": updates})
 
 
 def sequence_slice(input, offset, length, name=None):
-    return _seq_op("sequence_slice",
+    return _op("sequence_slice",
                    {"X": input, "Offset": offset, "Length": length})
 
 
@@ -698,8 +716,6 @@ def array_write(x, i, array):
 
 
 def array_read(array, i):
-    from paddle_tpu.layers import nn as _nn
-
     helper = LayerHelper("array_read")
     out = helper.create_variable_for_type_inference(dtype=array.dtype)
     helper.append_op("dynamic_slice",
@@ -777,10 +793,12 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
         ParamAttr._to_attr(bias_attr), [4 * hidden], dtype, is_bias=True)
     proj = helper.create_variable_for_type_inference(dtype=dtype)
     cell = helper.create_variable_for_type_inference(dtype=dtype)
+    lstmp_ins = {"Input": [input], "Weight": [w], "ProjWeight": [wp]}
+    if b is not None:
+        lstmp_ins["Bias"] = [b]
     helper.append_op(
         "lstmp",
-        inputs={"Input": [input], "Weight": [w], "ProjWeight": [wp],
-                "Bias": [b]},
+        inputs=lstmp_ins,
         outputs={"Projection": [proj], "Cell": [cell]},
         attrs={"is_reverse": is_reverse,
                "gate_activation": gate_activation,
@@ -823,10 +841,12 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
     out = helper.create_variable_for_type_inference(dtype=input.dtype)
     gate = helper.create_variable_for_type_inference(dtype=input.dtype)
     reset = helper.create_variable_for_type_inference(dtype=input.dtype)
+    gru_ins = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if b is not None:
+        gru_ins["Bias"] = [b]
     helper.append_op(
         "gru_unit",
-        inputs={"Input": [input], "HiddenPrev": [hidden], "Weight": [w],
-                "Bias": [b]},
+        inputs=gru_ins,
         outputs={"Hidden": [out], "Gate": [gate],
                  "ResetHiddenPrev": [reset]},
         attrs={"activation": activation,
@@ -878,9 +898,9 @@ def beam_search_decode(ids, scores, beam_size=None, end_id=None, name=None):
     from paddle_tpu.layers import nn as _nn
 
     best = _nn.argmax(scores, axis=-1)                     # [B]
-    best_ids = _seq_op("beam_gather", {"X": ids, "Index": best},
+    best_ids = _op("beam_gather", {"X": ids, "Index": best},
                        dtypes=("int64",))
-    best_scores = _seq_op("beam_gather", {"X": scores, "Index": best})
+    best_scores = _op("beam_gather", {"X": scores, "Index": best})
     return best_ids, best_scores
 
 
@@ -932,7 +952,13 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
         x = outs[0] if dirs == 1 else _nn.concat(outs, axis=-1)
         if dropout_prob and not is_test:
             x = _nn.dropout(x, dropout_prob)
-    return x, last_hs[-1], last_cs[-1]
+    # final states stacked [num_layers*dirs, B, H] (reference cudnn_lstm
+    # LastH/LastC layout)
+    last_h = _nn.stack([_nn.unsqueeze(v, [0]) for v in last_hs], axis=0)
+    last_h = _nn.reshape(last_h, [len(last_hs), -1, hidden_size])
+    last_c = _nn.stack([_nn.unsqueeze(v, [0]) for v in last_cs], axis=0)
+    last_c = _nn.reshape(last_c, [len(last_cs), -1, hidden_size])
+    return x, last_h, last_c
 
 
 def tensor_array_to_tensor(input, axis=1, name=None):

@@ -528,12 +528,20 @@ def _bilinear_interp(ins, attrs):
     n, c, h, w = jnp.shape(x)
     out_h, out_w = _interp_out_size(attrs, h, w)
     align = attrs.get("align_corners", True)
+    # align_corners=False splits further by align_mode (reference
+    # interpolate_op.cc): mode 1 (the API default) samples src = i*scale,
+    # mode 0 samples half-pixel centers
+    mode = int(attrs.get("align_mode", 1))
     if align and out_h > 1:
         ys = jnp.linspace(0.0, h - 1.0, out_h)
+    elif mode == 1:
+        ys = jnp.arange(out_h) * (h / out_h)
     else:
         ys = (jnp.arange(out_h) + 0.5) * h / out_h - 0.5
     if align and out_w > 1:
         xs = jnp.linspace(0.0, w - 1.0, out_w)
+    elif mode == 1:
+        xs = jnp.arange(out_w) * (w / out_w)
     else:
         xs = (jnp.arange(out_w) + 0.5) * w / out_w - 0.5
     ys = jnp.clip(ys, 0, h - 1)

@@ -398,15 +398,34 @@ def _conv3d(ins, attrs):
 
 @register_op("conv3d_transpose", diff_inputs=("Input", "Filter"))
 def _conv3d_transpose(ins, attrs):
+    """Gradient-of-conv semantics, filter [C_in, C_out/groups, kd, kh,
+    kw] (reference: conv_transpose_op.cc) — the 3-D twin of
+    conv2d_transpose, expressed as a fractionally-strided forward conv
+    (lhs_dilation) with groups/dilations honored."""
     x, w = _x(ins, "Input"), _x(ins, "Filter")
-    strides = _pair3(attrs.get("strides", [1, 1, 1]))
-    pads = _pair3(attrs.get("paddings", [0, 0, 0]))
-    out = jax.lax.conv_transpose(
-        x, w.transpose(1, 0, 2, 3, 4),
-        strides=strides,
-        padding=[(p, p) for p in pads],
+    sd, sh, sw = _pair3(attrs.get("strides", [1, 1, 1]))
+    pd, ph, pw = _pair3(attrs.get("paddings", [0, 0, 0]))
+    dd, dh, dw = _pair3(attrs.get("dilations", [1, 1, 1]))
+    groups = int(attrs.get("groups", 1))
+    kd, kh, kw = jnp.shape(w)[2], jnp.shape(w)[3], jnp.shape(w)[4]
+    if groups > 1:
+        ci = jnp.shape(w)[0]
+        wg = jnp.reshape(w, (groups, ci // groups) + tuple(jnp.shape(w)[1:]))
+        wg = jnp.flip(wg, axis=(-3, -2, -1))
+        wg = jnp.swapaxes(wg, 1, 2)
+        w_eff = jnp.reshape(wg, (-1, ci // groups, kd, kh, kw))
+    else:
+        w_eff = jnp.swapaxes(jnp.flip(w, axis=(-3, -2, -1)), 0, 1)
+    pads_eff = [(dd * (kd - 1) - pd,) * 2, (dh * (kh - 1) - ph,) * 2,
+                (dw * (kw - 1) - pw,) * 2]
+    out = jax.lax.conv_general_dilated(
+        x, w_eff,
+        window_strides=(1, 1, 1),
+        padding=pads_eff,
+        lhs_dilation=(sd, sh, sw),
+        rhs_dilation=(dd, dh, dw),
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
-        transpose_kernel=True,
+        feature_group_count=groups,
     )
     return {"Output": [out]}
 

@@ -352,3 +352,43 @@ def test_gru_unit_without_bias():
         h0 = to_variable(np.zeros((2, 8), np.float32))
         h, _, _ = gru(xproj, h0)
         assert h.shape == (2, 8)
+
+
+def test_dygraph_round4_layer_classes():
+    """The 8 reference dygraph classes added round 4 (Conv3D,
+    Conv3DTranspose, NCE, BilinearTensorProduct, SequenceConv, RowConv,
+    SpectralNorm, TreeConv) run forward with finite outputs."""
+    import numpy as np
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph import nn as dnn
+
+    r = np.random.RandomState(0)
+    with dygraph.guard():
+        x3d = dygraph.to_variable(
+            r.randn(2, 3, 4, 5, 5).astype(np.float32))
+        y = dnn.Conv3D("c3", 6, 3, padding=1)(x3d)
+        assert y.shape == (2, 6, 4, 5, 5)
+        yt = dnn.Conv3DTranspose("c3t", 6, 3, padding=1)(x3d)
+        assert yt.shape[1] == 6
+        feats = dygraph.to_variable(r.randn(4, 8).astype(np.float32))
+        lbl = dygraph.to_variable(r.randint(0, 10, (4, 1)).astype(np.int64))
+        cost = dnn.NCE("nce", 10, num_neg_samples=3)(feats, lbl)
+        assert cost.shape == (4, 1)
+        yb = dnn.BilinearTensorProduct("blt", 5)(feats, feats)
+        assert yb.shape == (4, 5)
+        seq = dygraph.to_variable(r.randn(2, 6, 8).astype(np.float32))
+        ys = dnn.SequenceConv("sc", 12, 3)(seq)
+        assert ys.shape == (2, 6, 12)
+        yr = dnn.RowConv("rc", 2)(seq)
+        assert yr.shape == (2, 6, 8)
+        w = dygraph.to_variable(r.randn(6, 8).astype(np.float32))
+        wn = dnn.SpectralNorm("sn", power_iters=2)(w)
+        assert wn.shape == (6, 8)
+        nodes = dygraph.to_variable(r.randn(2, 6, 4).astype(np.float32))
+        edges = dygraph.to_variable(np.tile(
+            np.array([[1, 2], [1, 3], [0, 0]], np.int32), (2, 1, 1)))
+        yt2 = dnn.TreeConv("tc", output_size=5, num_filters=2)(nodes, edges)
+        assert yt2.shape == (2, 6, 5, 2)
+        for v in (y, yt, cost, yb, ys, yr, wn, yt2):
+            assert np.isfinite(np.asarray(v.numpy(),
+                                          np.float64)).all()

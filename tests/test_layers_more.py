@@ -229,6 +229,7 @@ def test_lstm_layer_and_tensor_array_to_tensor():
             main, feed={"x": r.randn(B, T, D).astype(np.float32)},
             fetch_list=[out, last_h, last_c, t_out])]
     assert o.shape == (B, T, 2 * H)
-    assert lh.shape == (B, H) and lc.shape == (B, H)
+    # reference cudnn_lstm layout: [num_layers*dirs, B, H]
+    assert lh.shape == (4, B, H) and lc.shape == (4, B, H)
     assert np.isfinite(o).all()
     assert ta.shape == (B, 3, T, D)
