@@ -20,7 +20,7 @@ __all__ = [
     "detection_output", "multi_box_head", "yolov3_loss", "detection_map",
     "rpn_target_assign", "generate_proposals", "generate_proposal_labels",
     "distribute_fpn_proposals", "collect_fpn_proposals",
-    "box_decoder_and_assign", "box_clip",
+    "box_decoder_and_assign", "box_clip", "generate_mask_labels",
 ]
 
 
@@ -381,3 +381,23 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
                {"box_clip": box_clip},
                out_slots=("DecodeBox", "OutputAssignBox"), name=name,
                stop_gradient=True)
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         poly_lens=None, name=None):
+    """Mask R-CNN mask targets (reference: detection.py
+    generate_mask_labels). Dense-padded polygons: ``gt_segms``
+    [N, G, Q, V, 2] with ``poly_lens`` [N, G, Q] vertex counts replace
+    the reference's 3-level LoD. Returns (mask_rois, roi_has_mask_int32,
+    mask_int32) plus a per-image fg count var."""
+    ins = {"ImInfo": im_info, "GtClasses": gt_classes,
+           "IsCrowd": is_crowd, "GtSegms": gt_segms, "Rois": rois,
+           "LabelsInt32": labels_int32, "PolyLens": poly_lens}
+    mask_rois, has_mask, mask_i32, mask_num = _op(
+        "generate_mask_labels", ins,
+        {"num_classes": num_classes, "resolution": resolution},
+        out_slots=("MaskRois", "RoiHasMaskInt32", "MaskInt32", "MaskNum"),
+        dtypes=("float32", "int32", "int32", "int32"), name=name,
+        stop_gradient=True)
+    return mask_rois, has_mask, mask_i32, mask_num

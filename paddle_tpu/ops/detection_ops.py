@@ -801,3 +801,141 @@ def _detection_map(ins, attrs):
     m_ap = jnp.sum(jnp.where(have, aps, 0.0)) / jnp.maximum(
         jnp.sum(have), 1)
     return {"MAP": [m_ap.astype(jnp.float32)]}
+
+
+def _point_in_polygon(px, py, verts, n_valid):
+    """Crossing-number fill over a padded vertex list. px/py [M, M]
+    pixel-center sample points; verts [V, 2]; n_valid <= V real
+    vertices (edges wrap at n_valid). Padding edges contribute nothing."""
+    v = verts.shape[0]
+    idx = jnp.arange(v)
+    nxt = jnp.where(idx + 1 >= n_valid, 0, idx + 1)
+    x1, y1 = verts[:, 0], verts[:, 1]
+    x2 = verts[nxt, 0]
+    y2 = verts[nxt, 1]
+    edge_ok = idx < n_valid
+    px = px[..., None]
+    py = py[..., None]
+    crosses = ((y1 > py) != (y2 > py)) & (
+        px < (x2 - x1) * (py - y1) / jnp.where(
+            y2 - y1 == 0, 1e-12, y2 - y1) + x1
+    ) & edge_ok
+    return jnp.sum(crosses, axis=-1) % 2 == 1
+
+
+@register_op("generate_mask_labels", no_grad=True)
+def _generate_mask_labels(ins, attrs):
+    """Mask R-CNN mask targets (reference: generate_mask_labels_op.cc).
+
+    Dense-padded redesign of the 3-level-LoD polygon input: GtSegms
+    [N, G, Q, V, 2] holds up to Q polygon parts of up to V vertices per
+    gt, with PolyLens [N, G, Q] real vertex counts (0 = unused part).
+    GtClasses/IsCrowd [N, G] (class 0 = padding), Rois [N, R, 4],
+    LabelsInt32 [N, R] per-roi class (0 = background), ImInfo [N, 3].
+
+    Outputs (fixed capacity R, fg rois compacted to the front):
+    MaskRois [N, R, 4], RoiHasMaskInt32 [N, R] (source roi index, -1
+    pad), MaskInt32 [N, R, resolution^2 * num_classes] (-1 ignore
+    outside the roi's class block, as the reference's ExpandMaskTarget),
+    MaskNum [N]. Rasterization samples pixel centers with a
+    crossing-number fill; the reference delegates to pycocotools' RLE
+    rasterizer, so boundary pixels can differ by up to one pixel (the
+    training target semantics match)."""
+    im_info = _x(ins, "ImInfo").astype(jnp.float32)
+    gt_classes = _x(ins, "GtClasses").astype(jnp.int32)
+    is_crowd = _x(ins, "IsCrowd")
+    gt_segms = _x(ins, "GtSegms").astype(jnp.float32)
+    poly_lens = _x(ins, "PolyLens")
+    if poly_lens is not None:
+        poly_lens = poly_lens.astype(jnp.int32)
+    rois = _x(ins, "Rois").astype(jnp.float32)
+    labels = _x(ins, "LabelsInt32").astype(jnp.int32)
+    num_classes = int(attrs["num_classes"])
+    m = int(attrs["resolution"])
+    if is_crowd is None:
+        is_crowd = jnp.zeros_like(gt_classes)
+
+    n, g, q, v, _2 = gt_segms.shape
+    if poly_lens is None:
+        # no vertex counts declared: every part slot is a full-V polygon
+        poly_lens = jnp.full((n, g, q), v, jnp.int32)
+    r = rois.shape[1]
+
+    def one(im, cls, crowd, segs, plens, roi, lab):
+        valid_gt = (cls > 0) & (crowd.astype(jnp.int32) == 0) & (
+            jnp.sum(plens, axis=-1) > 0)
+        vert_ok = (jnp.arange(v)[None, None, :] < plens[..., None])
+        xs = jnp.where(vert_ok, segs[..., 0], jnp.inf)
+        ys = jnp.where(vert_ok, segs[..., 1], jnp.inf)
+        x0 = jnp.min(xs, axis=(1, 2))
+        y0 = jnp.min(ys, axis=(1, 2))
+        xs = jnp.where(vert_ok, segs[..., 0], -jnp.inf)
+        ys = jnp.where(vert_ok, segs[..., 1], -jnp.inf)
+        x1 = jnp.max(xs, axis=(1, 2))
+        y1 = jnp.max(ys, axis=(1, 2))
+        poly_boxes = jnp.stack([x0, y0, x1, y1], axis=-1)       # [G, 4]
+        poly_boxes = jnp.where(valid_gt[:, None], poly_boxes, 0.0)
+
+        scale = im[2]
+        roi_s = roi / scale
+        iou = _iou_xyxy(roi_s[None], poly_boxes[None])[0]       # [R, G]
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)     # [R]
+
+        fg = lab > 0
+        fg_num = jnp.sum(fg.astype(jnp.int32))
+
+        # rasterize each roi's matched gt polygons wrt the (unscaled) roi
+        bx0, by0 = roi_s[:, 0], roi_s[:, 1]
+        bw = jnp.maximum(roi_s[:, 2] - bx0, 1.0)
+        bh = jnp.maximum(roi_s[:, 3] - by0, 1.0)
+        # reference Poly2Mask samples the integer grid of the scaled
+        # polygon; pixel centers (j + 0.5) are the dense equivalent
+        grid = (jnp.arange(m, dtype=jnp.float32) + 0.5)
+        py_, px_ = jnp.meshgrid(grid, grid, indexing="ij")      # [M, M]
+
+        segs_r = segs[best_gt]                                  # [R, Q, V, 2]
+        plens_r = plens[best_gt]                                # [R, Q]
+        sx = (segs_r[..., 0] - bx0[:, None, None]) * m / bw[:, None, None]
+        sy = (segs_r[..., 1] - by0[:, None, None]) * m / bh[:, None, None]
+        verts = jnp.stack([sx, sy], axis=-1)                    # [R, Q, V, 2]
+
+        def raster_roi(vr, pl):
+            def raster_part(vp, np_):
+                return _point_in_polygon(px_, py_, vp, np_) & (np_ > 2)
+
+            parts = jax.vmap(raster_part)(vr, pl)               # [Q, M, M]
+            return jnp.any(parts, axis=0)
+
+        masks = jax.vmap(raster_roi)(verts, plens_r)            # [R, M, M]
+        masks = masks.reshape(r, m * m).astype(jnp.int32)
+
+        # expand into the per-class block (-1 = ignore)
+        mdim = m * m * num_classes
+        expanded = jnp.full((r, mdim), -1, jnp.int32)
+        col = lab[:, None] * (m * m) + jnp.arange(m * m)[None, :]
+        rowi = jnp.broadcast_to(jnp.arange(r)[:, None], (r, m * m))
+        expanded = expanded.at[rowi, col].set(
+            jnp.where(fg[:, None], masks, -1))
+
+        # compact fg rois to the front (stable)
+        order = jnp.argsort(jnp.where(fg, 0, 1), stable=True)
+        has_fg = fg_num > 0
+        # fg_num == 0: the first bg roi with an all -1 mask, class 0
+        take = jnp.where(has_fg, order, jnp.arange(r))
+        keep = jnp.where(
+            has_fg,
+            (jnp.arange(r) < fg_num),
+            jnp.arange(r) < 1,
+        )
+        mask_rois = jnp.where(keep[:, None], roi[take], -1.0)
+        roi_has_mask = jnp.where(keep, take.astype(jnp.int32), -1)
+        out_masks = jnp.where(
+            keep[:, None] & has_fg, expanded[take], -1)
+        count = jnp.where(has_fg, fg_num, 1)
+        return mask_rois, roi_has_mask, out_masks, count
+
+    mask_rois, roi_has_mask, mask_int32, counts = jax.vmap(one)(
+        im_info, gt_classes, is_crowd, gt_segms, poly_lens, rois, labels)
+    return {"MaskRois": [mask_rois], "RoiHasMaskInt32": [roi_has_mask],
+            "MaskInt32": [mask_int32], "MaskNum": [counts]}

@@ -341,3 +341,70 @@ def test_ssd_detection_output_shape():
         feed = ssd.synthetic_batch(2, num_classes=5, gt_capacity=4)
         det = exe.run(main, feed=feed, fetch_list=[model["detection"]])[0]
     assert det.shape[0] == 2 and det.shape[2] == 6
+
+
+def test_generate_mask_labels_dense():
+    """Square polygon filling the left half of the roi -> left half of
+    the MxM target is 1 (reference: generate_mask_labels_op.cc with the
+    dense-padded polygon encoding)."""
+    n, g, q, v, r, m, c = 1, 2, 2, 6, 4, 8, 3
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    gt_classes = np.array([[1, 0]], np.int32)
+    is_crowd = np.zeros((n, g), np.int32)
+    segms = np.zeros((n, g, q, v, 2), np.float32)
+    # gt 0: one square part covering x in [0, 16], y in [0, 32]
+    segms[0, 0, 0, :4] = [[0, 0], [16, 0], [16, 32], [0, 32]]
+    plens = np.zeros((n, g, q), np.int32)
+    plens[0, 0, 0] = 4
+    rois = np.zeros((n, r, 4), np.float32)
+    rois[0, 0] = [0, 0, 32, 32]     # fg: left half covered by the poly
+    rois[0, 1] = [0, 0, 8, 8]       # fg: fully inside the poly
+    labels = np.zeros((n, r), np.int32)
+    labels[0, 0] = 1
+    labels[0, 1] = 2
+    outs = get_op_def("generate_mask_labels").compute(
+        {"ImInfo": [im_info], "GtClasses": [gt_classes],
+         "IsCrowd": [is_crowd], "GtSegms": [segms], "PolyLens": [plens],
+         "Rois": [rois], "LabelsInt32": [labels]},
+        {"num_classes": c, "resolution": m})
+    mask_rois = np.asarray(outs["MaskRois"][0])
+    has_mask = np.asarray(outs["RoiHasMaskInt32"][0])
+    masks = np.asarray(outs["MaskInt32"][0])
+    count = np.asarray(outs["MaskNum"][0])
+    assert count[0] == 2
+    assert set(has_mask[0][:2].tolist()) == {0, 1}
+    np.testing.assert_allclose(mask_rois[0, 0], rois[0, 0])
+    # roi 0 (class 1): left half of the grid inside the polygon
+    m0 = masks[0, 0].reshape(c, m, m)[1]
+    assert (m0[:, : m // 2] == 1).all()
+    assert (m0[:, m // 2:] == 0).all()
+    # other class blocks are ignore (-1)
+    assert (masks[0, 0].reshape(c, m, m)[2] == -1).all()
+    # roi 1 (class 2): fully inside -> all ones in class-2 block
+    m1 = masks[0, 1].reshape(c, m, m)[2]
+    assert (m1 == 1).all()
+    # padding rows
+    assert (has_mask[0][2:] == -1).all()
+    assert (masks[0, 2:] == -1).all()
+
+
+def test_generate_mask_labels_no_fg():
+    n, g, q, v, r, m, c = 1, 1, 1, 6, 3, 4, 2
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    gt_classes = np.ones((n, g), np.int32)
+    segms = np.zeros((n, g, q, v, 2), np.float32)
+    segms[0, 0, 0, :4] = [[0, 0], [8, 0], [8, 8], [0, 8]]
+    plens = np.full((n, g, q), 4, np.int32)
+    rois = np.tile(np.array([[0, 0, 8, 8]], np.float32), (n, r, 1))
+    labels = np.zeros((n, r), np.int32)    # all background
+    outs = get_op_def("generate_mask_labels").compute(
+        {"ImInfo": [im_info], "GtClasses": [gt_classes],
+         "IsCrowd": [np.zeros((n, g), np.int32)], "GtSegms": [segms],
+         "PolyLens": [plens], "Rois": [rois], "LabelsInt32": [labels]},
+        {"num_classes": c, "resolution": m})
+    count = np.asarray(outs["MaskNum"][0])
+    masks = np.asarray(outs["MaskInt32"][0])
+    has = np.asarray(outs["RoiHasMaskInt32"][0])
+    assert count[0] == 1          # one bg roi stand-in
+    assert has[0, 0] == 0 and (has[0, 1:] == -1).all()
+    assert (masks[0, 0] == -1).all()   # all-ignore mask
