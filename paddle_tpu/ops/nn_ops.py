@@ -801,3 +801,23 @@ def _sample_logits(ins, attrs, rng=None):
             "Probabilities": [q],
             "SampledLogits": [adjusted],
             "SampledLabel": [sampled_label]}
+
+
+@register_op("fc", diff_inputs=("Input", "W", "Bias"))
+def _fc_fused(ins, attrs):
+    """Fused fully-connected op — the rewrite target of the fc_fuse pass
+    (reference: operators/fc_op.cc + framework/ir/fc_fuse_pass.cc:
+    mul + elementwise_add collapse into one kernel). Mirrors the mul
+    op's flatten semantics, then adds the bias on the output columns."""
+    import math as _m
+
+    x, w = ins["Input"][0], ins["W"][0]
+    b_in = ins.get("Bias")
+    b = b_in[0] if b_in else None
+    xnc = int(attrs.get("in_num_col_dims", 1))
+    xs, wsh = jnp.shape(x), jnp.shape(w)
+    x2 = jnp.reshape(x, (_m.prod(xs[:xnc]), -1))
+    out2 = x2 @ w
+    if b is not None:
+        out2 = out2 + jnp.reshape(b, (1, -1))
+    return {"Out": [jnp.reshape(out2, xs[:xnc] + wsh[1:])]}

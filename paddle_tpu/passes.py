@@ -115,3 +115,67 @@ def _inference_prune(program, scope=None, targets=None, feeds=None, **kw):
     from paddle_tpu import io as _io
 
     return _io._prune_for_inference(program, feeds or [], targets)
+
+
+@register_pass("fc_fuse")
+def _fc_fuse(program, scope=None, **kw):
+    """Collapse mul + elementwise_add pairs into single fc ops
+    (reference: framework/ir/fc_fuse_pass.cc). Program-level rewrite:
+    the mul's output must feed ONLY the add, the add's Y must be a 1-D
+    bias on the TRAILING axis, and the mul must use the default
+    y_num_col_dims (2-D W). Mostly useful for the sub-block interp path
+    and smaller serialized programs — XLA fuses the pair anyway in
+    whole-program compilation. The mul's intermediate (pre-bias) var is
+    no longer produced after fusion; fetch the fc output instead."""
+    from paddle_tpu.framework import Operator
+
+    block = program.global_block()
+    consumers: Dict[str, List[int]] = {}
+    for idx, op in enumerate(block.ops):
+        for n in op.input_arg_names:
+            consumers.setdefault(n, []).append(idx)
+
+    fused = 0
+    new_ops = []
+    skip = set()
+    for idx, op in enumerate(block.ops):
+        if idx in skip:
+            continue
+        if op.type == "mul":
+            out = op.outputs["Out"][0]
+            cons = consumers.get(out, [])
+            if len(cons) == 1:
+                nxt = block.ops[cons[0]]
+                y = nxt.inputs.get("Y", [None])[0]
+                yv = block._find_var_recursive(y) if y else None
+                xnc = int(op.attrs.get("x_num_col_dims", 1))
+                add_axis = int(nxt.attrs.get("axis", -1))
+                if (nxt.type == "elementwise_add"
+                        and nxt.inputs["X"][0] == out
+                        and yv is not None and yv.shape is not None
+                        and len(yv.shape) == 1
+                        # bias must land on the TRAILING (column) axis:
+                        # the mul output is rank xnc+1
+                        and add_axis in (-1, xnc)
+                        # fc mirrors mul only for 2-D W (default
+                        # y_num_col_dims)
+                        and int(op.attrs.get("y_num_col_dims", 1)) == 1):
+                    new_ops.append(Operator(
+                        block, "fc",
+                        inputs={"Input": list(op.inputs["X"]),
+                                "W": list(op.inputs["Y"]),
+                                "Bias": [y]},
+                        outputs={"Out": list(nxt.outputs["Out"])},
+                        attrs={"in_num_col_dims":
+                               int(op.attrs.get("x_num_col_dims", 1))},
+                    ))
+                    skip.add(cons[0])
+                    # the pre-bias intermediate is no longer produced
+                    block.vars.pop(out, None)
+                    fused += 1
+                    continue
+        new_ops.append(op)
+    if fused:
+        block.ops[:] = new_ops
+        program._bump_version()
+    return program

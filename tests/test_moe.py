@@ -98,7 +98,8 @@ def test_moe_trains_to_specialize():
     for k, gr in g0["params"].items():
         gr = np.asarray(gr)
         assert np.isfinite(gr).all() and np.abs(gr).max() > 0, k
-    assert np.abs(np.asarray(g0["gate"])).max() > 0
+    ggate = np.asarray(g0["gate"])
+    assert np.isfinite(ggate).all() and np.abs(ggate).max() > 0
     for _ in range(60):
         grads = g(state)
         state = jax.tree.map(lambda p, gr: p - lr * gr, state, grads)

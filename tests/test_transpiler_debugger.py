@@ -137,3 +137,31 @@ def test_bn_fold_keeps_shared_stats_vars():
     # the surviving batch_norm still finds its shared stats vars
     assert main.global_block()._find_var_recursive("shared.mean") is not None
     assert main.global_block()._find_var_recursive("shared.var") is not None
+
+
+def test_fc_fuse_pass_parity():
+    """fc_fuse collapses mul+add into fc ops (reference:
+    framework/ir/fc_fuse_pass.cc) with numeric parity."""
+    import numpy as np
+
+    from paddle_tpu import passes
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 6], append_batch_size=False)
+        h = layers.fc(layers.fc(x, 8, act="relu"), 3)
+    infer = main.clone(for_test=True)
+    before = [o.type for o in infer.global_block().ops]
+    passes.apply_pass("fc_fuse", infer)
+    after = [o.type for o in infer.global_block().ops]
+    assert before.count("mul") == 2 and after.count("mul") == 0
+    assert after.count("fc") == 2
+    assert "elementwise_add" not in after
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        (a,) = exe.run(main, feed={"x": xv}, fetch_list=[h])
+        (b,) = exe.run(infer, feed={"x": xv}, fetch_list=[h.name])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
