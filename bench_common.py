@@ -43,6 +43,22 @@ def run_windows(exe, program, loss, feeds, steps=30, n_windows=3,
 
         multi = os.environ.get("PT_BENCH_MULTI", "1") == "1"
     if multi:
+        # Freeze the feed buffers ONCE (owning non-writeable copies) so
+        # run_steps' staging cache may legally key on identity —
+        # mutable numpy feeds are re-staged every call, which would put
+        # the device_put stack back inside the timed window. Owning
+        # copies, not views: a frozen view is still mutable through its
+        # base, so the executor refuses to cache it.
+        frozen = []
+        for fd in feeds:
+            ffd = {}
+            for k, v in fd.items():
+                if isinstance(v, np.ndarray):
+                    v = v.copy()
+                    v.flags.writeable = False
+                ffd[k] = v
+            frozen.append(ffd)
+        feeds = frozen
         # warmup = one full-size window so only ONE multi-step executable
         # is compiled (steps is a static arg). The windowed program +
         # stacked feeds cost more HBM than the single-step program the

@@ -70,6 +70,45 @@ def test_run_steps_matches_stepwise():
     assert step_losses[-1] < step_losses[0]
 
 
+def test_run_steps_sees_in_place_feed_mutation():
+    """A feed buffer refilled in place between run_steps calls (the
+    preallocated-loader pattern) must be re-staged, not served from the
+    identity cache. Only OWNING frozen arrays may be cached — a frozen
+    view is still mutable through its writeable base."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 4], append_batch_size=False,
+                        stop_gradient=True)
+        s = layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    buf = np.full((4, 4), 1.0, np.float32)
+    out = exe.run_steps(main, feed_list=[{"x": buf}], steps=1,
+                        fetch_list=[s])
+    assert float(np.asarray(out[0])) == 16.0
+    buf[...] = 2.0  # in-place refill, same identity
+    out = exe.run_steps(main, feed_list=[{"x": buf}], steps=1,
+                        fetch_list=[s])
+    assert float(np.asarray(out[0])) == 32.0
+    # a frozen VIEW must NOT be cached: its base is still writeable
+    view = buf.view()
+    view.flags.writeable = False
+    exe.run_steps(main, feed_list=[{"x": view}], steps=1, fetch_list=[s])
+    assert exe._latest_stacked is None
+    buf[...] = 3.0  # mutation through the base reaches the frozen view
+    out = exe.run_steps(main, feed_list=[{"x": view}], steps=1,
+                        fetch_list=[s])
+    assert float(np.asarray(out[0])) == 48.0
+    # an OWNING frozen copy DOES hit the staging cache
+    frozen = buf.copy()
+    frozen.flags.writeable = False
+    exe.run_steps(main, feed_list=[{"x": frozen}], steps=1, fetch_list=[s])
+    cached = exe._latest_stacked[1]["x"]
+    # an interleaved mutable-feed call must not wipe the frozen entry
+    exe.run_steps(main, feed_list=[{"x": buf}], steps=1, fetch_list=[s])
+    exe.run_steps(main, feed_list=[{"x": frozen}], steps=1, fetch_list=[s])
+    assert exe._latest_stacked[1]["x"] is cached
+
+
 def test_run_steps_continues_the_step_counter():
     main, startup, loss = _build(seed=11)
     feeds = _feeds(2)
