@@ -88,8 +88,9 @@ pt_predictor* pt_predictor_create(const char* model_dir) {
   if (!EnsurePython()) return nullptr;
   PyObject* globals = PyDict_New();
   PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
-  PyDict_SetItemString(globals, "MODEL_DIR",
-                       PyUnicode_FromString(model_dir));
+  PyObject* dir_obj = PyUnicode_FromString(model_dir);
+  PyDict_SetItemString(globals, "MODEL_DIR", dir_obj);  // does not steal
+  Py_DECREF(dir_obj);
   static const char kCreate[] = R"PY(
 import numpy as np
 from paddle_tpu.inference import Config, create_predictor
@@ -149,9 +150,14 @@ int pt_predictor_run(pt_predictor* p, int n_inputs,
     PyObject* mv = PyMemoryView_FromMemory(
         const_cast<char*>(static_cast<const char*>(data[i])),
         numel * kDtypeSize[dtypes[i]], PyBUF_READ);
-    PyObject* spec = PyTuple_Pack(
-        4, PyUnicode_FromString(names[i]), mv,
-        PyLong_FromLong(dtypes[i]), shape);
+    // PyTuple_Pack increfs its arguments: every temporary must be
+    // released here or each call leaks one ref per input (unbounded
+    // growth in a steady-state serving loop).
+    PyObject* name_obj = PyUnicode_FromString(names[i]);
+    PyObject* code_obj = PyLong_FromLong(dtypes[i]);
+    PyObject* spec = PyTuple_Pack(4, name_obj, mv, code_obj, shape);
+    Py_DECREF(name_obj);
+    Py_DECREF(code_obj);
     Py_DECREF(mv);
     Py_DECREF(shape);
     PyList_SetItem(specs, i, spec);  // steals spec

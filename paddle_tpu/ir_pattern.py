@@ -1,0 +1,100 @@
+"""Shared producer/consumer pattern matching over a Block's ops.
+
+The TPU-native analog of the reference's GraphPatternDetector
+(reference: paddle/fluid/framework/ir/graph_pattern_detector.cc) at
+Program altitude: instead of an IR node graph, the index is built
+directly over ``block.ops`` and keyed by var name. Fusion passes that
+previously hand-rolled their own producer/consumer maps (the
+InferenceTranspiler conv+BN fold and the fc_fuse pass duplicated the
+same walk) share this one.
+
+The matcher is deliberately small: the only pattern shape our passes
+need is a two-op chain where the first op's output feeds exactly one
+consumer. Richer DAG patterns stay subsumed by XLA fusion (SURVEY.md
+section 7 phase 4) — this exists for PROGRAM rewrites that must happen
+before lowering (serving-artifact folding, quantization placement).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+
+class BlockGraph:
+    """Producer/consumer index over ``block.ops``.
+
+    ``producer[name]`` is the index of the op that (last) writes the
+    var; ``consumers[name]`` lists indices of every op reading it, in
+    program order. Built once — passes that mutate the block should
+    re-create the graph (or finish matching before rewriting, the
+    pattern both built-in passes use).
+    """
+
+    def __init__(self, block):
+        self.block = block
+        self.producer: Dict[str, int] = {}
+        self.consumers: Dict[str, List[int]] = {}
+        for idx, op in enumerate(block.ops):
+            for n in op.input_arg_names:
+                self.consumers.setdefault(n, []).append(idx)
+            for n in op.output_arg_names:
+                self.producer[n] = idx
+
+    def producer_op(self, name: str):
+        """(index, op) of the var's producer, or None (fed/parameter)."""
+        idx = self.producer.get(name)
+        return None if idx is None else (idx, self.block.ops[idx])
+
+    def sole_consumer(self, name: str):
+        """(index, op) when exactly one op reads the var, else None."""
+        cons = self.consumers.get(name, [])
+        return None if len(cons) != 1 else (cons[0], self.block.ops[cons[0]])
+
+    def available_before(self, name: str, idx: int) -> bool:
+        """True when ``name`` is defined before op ``idx`` runs: either
+        it has no producer in this block (a parameter, feed, or outer
+        var) or its producer precedes ``idx`` in program order. Fusions
+        that splice an op at position ``idx`` must check this for every
+        new input, or they can read a var before it exists."""
+        p = self.producer.get(name)
+        return p is None or p < idx
+
+    def is_persistable(self, name: str) -> bool:
+        v = self.block._find_var_recursive(name)
+        return bool(v is not None and getattr(v, "persistable", False))
+
+
+def match_chain(
+    graph: BlockGraph,
+    first_types: Sequence[str],
+    out_slot: str,
+    second_type: str,
+    in_slot: str,
+    second_pred: Optional[Callable] = None,
+) -> Iterator[Tuple[int, int]]:
+    """Yield ``(i, j)`` index pairs where ``ops[i]`` is one of
+    ``first_types``, its ``out_slot`` output is read ONLY by ``ops[j]``
+    (a ``second_type`` whose ``in_slot`` input is that var), and the
+    chain runs forward (``i < j``). ``second_pred(op)`` optionally
+    filters the consumer (e.g. ``is_test`` batch norms).
+
+    The sole-consumer requirement is what makes collapsing the pair
+    safe: any other reader of the intermediate would observe a var that
+    the fused op no longer produces.
+    """
+    first_types = set(first_types)
+    for i, op in enumerate(graph.block.ops):
+        if op.type not in first_types or out_slot not in op.outputs:
+            continue
+        out = op.outputs[out_slot][0]
+        hit = graph.sole_consumer(out)
+        if hit is None:
+            continue
+        j, nxt = hit
+        if j <= i or nxt.type != second_type:
+            continue
+        if nxt.inputs.get(in_slot, [None])[0] != out:
+            continue
+        if second_pred is not None and not second_pred(nxt):
+            continue
+        yield i, j

@@ -1589,7 +1589,15 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
 def hash(input, hash_size, num_hash=1, name=None):
     """Multi-seed feature hashing into ``[0, hash_size)`` buckets
     (reference: layers/nn.py:10456 + operators/hash_op.cc). ``input``
-    [N, d] integer ids; output [N, num_hash, 1]."""
+    [N, d] integer ids; output [N, num_hash, 1].
+
+    Bucket-value compatibility: under ``jax_enable_x64`` the op is
+    bit-exact XXH64 and buckets match the reference (so vocabularies,
+    pretrained embedding tables, and serving systems built against
+    reference hash buckets port numerically). With x64 DISABLED (the
+    JAX default) a different mixer is used and bucket values differ
+    from the reference — enable x64 before building or porting any
+    artifact keyed by hash buckets."""
     helper = LayerHelper("hash", name=name)
     out = helper.create_variable_for_type_inference(
         dtype=input.dtype, stop_gradient=True)

@@ -35,6 +35,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from paddle_tpu.core.registry import register_op
 
@@ -273,18 +274,106 @@ def _print(ins, attrs):
 _FNV_PRIME = np.uint32(16777619)
 _FNV_BASIS = np.uint32(2166136261)
 
+# xxHash64 prime constants (public-domain algorithm, Yann Collet)
+_XXP1 = 0x9E3779B185EBCA87
+_XXP2 = 0xC2B2AE3D27D4EB4F
+_XXP3 = 0x165667B19E3779F9
+_XXP4 = 0x85EBCA77C2B2AE63
+_XXP5 = 0x27D4EB2F165667C5
+
+
+def _rotl64(x, r):
+    return (x << np.uint64(r)) | (x >> np.uint64(64 - r))
+
+
+def _xx_round(acc, lane):
+    return _rotl64(acc + lane * np.uint64(_XXP2), 31) * np.uint64(_XXP1)
+
+
+def _xxh64_words(words, seeds):
+    """XXH64 of a ``4*n``-byte stream given as little-endian uint32
+    words ``[..., n]``, for every seed in ``seeds`` [m]; returns
+    ``[..., m]`` uint64. The word count is static, so the stripe/lane
+    structure unrolls into straight-line XLA ops — vectorized over all
+    leading batch dims and seeds at once. Requires x64 mode (uint64
+    lattice). Implements the public xxHash64 spec; input length is
+    always a word multiple so there is no single-byte tail."""
+    n = words.shape[-1]
+    length = np.uint64(4 * n)
+    w64 = words.astype(jnp.uint64)
+    # 8-byte lanes = little-endian word pairs
+    lanes = [w64[..., 2 * k] | (w64[..., 2 * k + 1] << np.uint64(32))
+             for k in range(n // 2)]
+    batch = words.shape[:-1]
+    seeds = jnp.broadcast_to(seeds.astype(jnp.uint64),
+                             batch + seeds.shape)
+    lanes = [l[..., None] for l in lanes]          # broadcast vs seeds
+
+    n_stripes = n // 8
+    if n_stripes:                                   # >= 32 bytes
+        v1 = seeds + np.uint64(_XXP1) + np.uint64(_XXP2)
+        v2 = seeds + np.uint64(_XXP2)
+        v3 = seeds + np.uint64(0)
+        v4 = seeds - np.uint64(_XXP1)
+        for s in range(n_stripes):
+            v1 = _xx_round(v1, lanes[4 * s])
+            v2 = _xx_round(v2, lanes[4 * s + 1])
+            v3 = _xx_round(v3, lanes[4 * s + 2])
+            v4 = _xx_round(v4, lanes[4 * s + 3])
+        h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12)
+             + _rotl64(v4, 18))
+        for v in (v1, v2, v3, v4):
+            h = (h ^ _xx_round(jnp.zeros_like(v), v)) \
+                * np.uint64(_XXP1) + np.uint64(_XXP4)
+    else:
+        h = seeds + np.uint64(_XXP5)
+    h = h + length
+    for k in range(n_stripes * 4, n // 2):          # leftover 8B lanes
+        h = _rotl64(h ^ _xx_round(jnp.zeros_like(h), lanes[k]), 27) \
+            * np.uint64(_XXP1) + np.uint64(_XXP4)
+    if n % 2:                                       # leftover 4B word
+        h = _rotl64(h ^ (w64[..., -1:] * np.uint64(_XXP1)), 23) \
+            * np.uint64(_XXP2) + np.uint64(_XXP3)
+    h = h ^ (h >> np.uint64(33))
+    h = h * np.uint64(_XXP2)
+    h = h ^ (h >> np.uint64(29))
+    h = h * np.uint64(_XXP3)
+    return h ^ (h >> np.uint64(32))
+
 
 @register_op("hash", no_grad=True)
 def _hash(ins, attrs):
     """Multi-seed feature hashing (reference: operators/hash_op.cc/.h —
-    out[row, i] = XXH64(row_bytes, seed=i) % mod_by, out dims = in dims
-    minus last + [num_hash, 1]). Here: a per-seed FNV-1a mix over the
-    last-axis integers, vectorized over rows and seeds; same contract
-    (deterministic, uniform over [0, mod_by)), different bucket values
-    than xxHash."""
+    out[row, i] = XXH64(row_bytes, sizeof(int)*last_dim, seed=i)
+    % mod_by, out dims = in dims minus last + [num_hash, 1]; note the
+    reference hashes ``sizeof(int)`` — 4 — bytes per element even for
+    int64 rows, i.e. the first 4*last_dim bytes of the row).
+
+    Under x64 mode this is bit-exact XXH64 (same buckets as the
+    reference, byte-prefix quirk included). With x64 disabled uint64
+    arithmetic is unavailable and a per-seed FNV-1a mix is substituted:
+    same contract (deterministic, uniform over [0, mod_by)), DIFFERENT
+    bucket values — vocabularies built against reference buckets only
+    port under ``jax_enable_x64``."""
     x = _x(ins)
     num_hash = int(attrs.get("num_hash", 1))
     mod_by = int(attrs.get("mod_by", 100000))
+    if jax.config.jax_enable_x64:
+        if x.dtype in (jnp.int64, jnp.uint64):
+            # word stream of the row's bytes, truncated to 4 bytes per
+            # element (the reference's sizeof(int) read)
+            a = lax.bitcast_convert_type(x, jnp.uint64)
+            lo = (a & np.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+            hi = (a >> np.uint64(32)).astype(jnp.uint32)
+            words = jnp.stack([lo, hi], axis=-1).reshape(
+                x.shape[:-1] + (2 * x.shape[-1],))[..., :x.shape[-1]]
+        else:
+            words = lax.bitcast_convert_type(
+                x.astype(jnp.int32), jnp.uint32)
+        seeds = jnp.arange(num_hash, dtype=jnp.uint64)
+        h = _xxh64_words(words, seeds)
+        out = (h % np.uint64(mod_by)).astype(x.dtype)
+        return {"Out": [out[..., None]]}
     # Fold the high word before narrowing so 64-bit ids differing only
     # above bit 31 don't collide. Under JAX's default x64-disabled mode
     # int64 feeds are already truncated to int32 at trace entry (the id

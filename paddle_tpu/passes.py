@@ -118,64 +118,67 @@ def _inference_prune(program, scope=None, targets=None, feeds=None, **kw):
 
 
 @register_pass("fc_fuse")
-def _fc_fuse(program, scope=None, **kw):
+def _fc_fuse(program, scope=None, fetch_targets=(), **kw):
     """Collapse mul + elementwise_add pairs into single fc ops
-    (reference: framework/ir/fc_fuse_pass.cc). Program-level rewrite:
-    the mul's output must feed ONLY the add, the add's Y must be a 1-D
-    bias on the TRAILING axis, and the mul must use the default
-    y_num_col_dims (2-D W). Mostly useful for the sub-block interp path
-    and smaller serialized programs — XLA fuses the pair anyway in
-    whole-program compilation. The mul's intermediate (pre-bias) var is
-    no longer produced after fusion; fetch the fc output instead."""
+    (reference: framework/ir/fc_fuse_pass.cc). Program-level rewrite on
+    the shared matcher (ir_pattern.match_chain): the mul's output must
+    feed ONLY the add, the add's Y must be a 1-D bias that is already
+    DEFINED at the mul's position (a parameter or an earlier op's
+    output — the fc is spliced where the mul was, so a later-produced
+    bias would be read before it exists), added on the TRAILING axis,
+    and the mul must use the default y_num_col_dims (2-D W). Mostly
+    useful for the sub-block interp path and smaller serialized
+    programs — XLA fuses the pair anyway in whole-program compilation.
+    The mul's intermediate (pre-bias) var is no longer produced after
+    fusion, so fusion is skipped when it is persistable or named in
+    ``fetch_targets``; fetch the fc output otherwise."""
     from paddle_tpu.framework import Operator
+    from paddle_tpu.ir_pattern import BlockGraph, match_chain
 
     block = program.global_block()
-    consumers: Dict[str, List[int]] = {}
-    for idx, op in enumerate(block.ops):
-        for n in op.input_arg_names:
-            consumers.setdefault(n, []).append(idx)
+    graph = BlockGraph(block)
+    fetch_names = {
+        f if isinstance(f, str) else f.name for f in fetch_targets
+    }
 
-    fused = 0
-    new_ops = []
-    skip = set()
-    for idx, op in enumerate(block.ops):
-        if idx in skip:
+    plans = []  # (mul idx, add idx, fused Operator)
+    for i, j in match_chain(graph, ("mul",), "Out",
+                            "elementwise_add", "X"):
+        op, nxt = block.ops[i], block.ops[j]
+        out = op.outputs["Out"][0]
+        if graph.is_persistable(out) or out in fetch_names:
             continue
-        if op.type == "mul":
-            out = op.outputs["Out"][0]
-            cons = consumers.get(out, [])
-            if len(cons) == 1:
-                nxt = block.ops[cons[0]]
-                y = nxt.inputs.get("Y", [None])[0]
-                yv = block._find_var_recursive(y) if y else None
-                xnc = int(op.attrs.get("x_num_col_dims", 1))
-                add_axis = int(nxt.attrs.get("axis", -1))
-                if (nxt.type == "elementwise_add"
-                        and nxt.inputs["X"][0] == out
-                        and yv is not None and yv.shape is not None
-                        and len(yv.shape) == 1
-                        # bias must land on the TRAILING (column) axis:
-                        # the mul output is rank xnc+1
-                        and add_axis in (-1, xnc)
-                        # fc mirrors mul only for 2-D W (default
-                        # y_num_col_dims)
-                        and int(op.attrs.get("y_num_col_dims", 1)) == 1):
-                    new_ops.append(Operator(
-                        block, "fc",
-                        inputs={"Input": list(op.inputs["X"]),
-                                "W": list(op.inputs["Y"]),
-                                "Bias": [y]},
-                        outputs={"Out": list(nxt.outputs["Out"])},
-                        attrs={"in_num_col_dims":
-                               int(op.attrs.get("x_num_col_dims", 1))},
-                    ))
-                    skip.add(cons[0])
-                    # the pre-bias intermediate is no longer produced
-                    block.vars.pop(out, None)
-                    fused += 1
-                    continue
-        new_ops.append(op)
-    if fused:
-        block.ops[:] = new_ops
+        y = nxt.inputs.get("Y", [None])[0]
+        yv = block._find_var_recursive(y) if y else None
+        xnc = int(op.attrs.get("x_num_col_dims", 1))
+        add_axis = int(nxt.attrs.get("axis", -1))
+        if (yv is not None and yv.shape is not None
+                and len(yv.shape) == 1
+                # the fused fc runs at the mul's position
+                and graph.available_before(y, i)
+                # bias must land on the TRAILING (column) axis: the
+                # mul output is rank xnc+1
+                and add_axis in (-1, xnc)
+                # fc mirrors mul only for 2-D W (default y_num_col_dims)
+                and int(op.attrs.get("y_num_col_dims", 1)) == 1):
+            plans.append((i, j, Operator(
+                block, "fc",
+                inputs={"Input": list(op.inputs["X"]),
+                        "W": list(op.inputs["Y"]),
+                        "Bias": [y]},
+                outputs={"Out": list(nxt.outputs["Out"])},
+                attrs={"in_num_col_dims": xnc},
+            )))
+
+    if plans:
+        replace = {i: fc for i, _, fc in plans}
+        drop = {j for _, j, _ in plans}
+        for i, _, _ in plans:
+            # the pre-bias intermediate is no longer produced
+            block.vars.pop(block.ops[i].outputs["Out"][0], None)
+        block.ops[:] = [
+            replace.get(idx, op) for idx, op in enumerate(block.ops)
+            if idx not in drop
+        ]
         program._bump_version()
     return program

@@ -31,35 +31,24 @@ class InferenceTranspiler:
         """Folds conv+BN pairs in place (program ops AND scope weights).
         Use on an inference program (``clone(for_test=True)``); returns
         the number of BN ops folded."""
+        from paddle_tpu.ir_pattern import BlockGraph, match_chain
+
         block = program.global_block()
-        # var name -> (op index, op) of its single producer
-        producer = {}
-        consumers: dict = {}
-        for idx, op in enumerate(block.ops):
-            for n in op.input_arg_names:
-                consumers.setdefault(n, []).append(idx)
-            for n in op.output_arg_names:
-                producer[n] = (idx, op)
+        graph = BlockGraph(block)
 
         folded = 0
-        for idx, op in enumerate(block.ops):
-            if op.type != "batch_norm" or not op.attrs.get("is_test", False):
-                continue
+        # chain: conv output feeds ONLY this inference-mode BN (any
+        # other consumer would observe the pre-fold activations)
+        for p_idx, idx in match_chain(
+                graph, tuple(_FOLDABLE_PRODUCERS), "Output",
+                "batch_norm", "X",
+                second_pred=lambda o: o.attrs.get("is_test", False)):
+            p_op, op = block.ops[p_idx], block.ops[idx]
             x_name = op.inputs["X"][0]
-            prod = producer.get(x_name)
-            if prod is None:
-                continue
-            p_idx, p_op = prod
-            if p_op.type not in _FOLDABLE_PRODUCERS:
-                continue
-            # the conv output must feed ONLY this BN, or folding changes
-            # the other consumers
-            if consumers.get(x_name, []) != [idx]:
-                continue
 
             w_name = p_op.inputs["Filter"][0]
             # a filter shared by other ops cannot be folded in place
-            if len(consumers.get(w_name, [])) > 1:
+            if len(graph.consumers.get(w_name, [])) > 1:
                 continue
             w = np.asarray(scope.find_var(w_name))
             scale = np.asarray(scope.find_var(op.inputs["Scale"][0]))
@@ -94,7 +83,7 @@ class InferenceTranspiler:
             # (unless another, unfolded op still consumes them)
             for slot in ("Scale", "Bias", "Mean", "Variance"):
                 for dead in op.inputs.get(slot, []):
-                    if consumers.get(dead, []) == [idx]:
+                    if graph.consumers.get(dead, []) == [idx]:
                         block.vars.pop(dead, None)
             folded += 1
 

@@ -183,6 +183,90 @@ def test_hash_shape_range_determinism():
     assert len(set(h[0, :, 0].tolist())) > 1
 
 
+def _py_xxh64(data: bytes, seed: int) -> int:
+    """Pure-python XXH64 from the public spec (Yann Collet), used as the
+    oracle for bucket parity with the reference's xxhash library."""
+    M = (1 << 64) - 1
+    P1, P2, P3 = 0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9
+    P4, P5 = 0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & M
+
+    def rnd(acc, lane):
+        return (rotl((acc + lane * P2) & M, 31) * P1) & M
+
+    n, i = len(data), 0
+    if n >= 32:
+        v = [(seed + P1 + P2) & M, (seed + P2) & M, seed & M,
+             (seed - P1) & M]
+        while i + 32 <= n:
+            for k in range(4):
+                lane = int.from_bytes(data[i:i + 8], "little")
+                v[k] = rnd(v[k], lane)
+                i += 8
+        h = (rotl(v[0], 1) + rotl(v[1], 7) + rotl(v[2], 12)
+             + rotl(v[3], 18)) & M
+        for k in range(4):
+            h = ((h ^ rnd(0, v[k])) * P1 + P4) & M
+    else:
+        h = (seed + P5) & M
+    h = (h + n) & M
+    while i + 8 <= n:
+        lane = int.from_bytes(data[i:i + 8], "little")
+        h = (rotl(h ^ rnd(0, lane), 27) * P1 + P4) & M
+        i += 8
+    if i + 4 <= n:
+        w = int.from_bytes(data[i:i + 4], "little")
+        h = (rotl(h ^ (w * P1) & M, 23) * P2 + P3) & M
+        i += 4
+    while i < n:
+        h = (rotl(h ^ (data[i] * P5) & M, 11) * P1) & M
+        i += 1
+    h ^= h >> 33
+    h = (h * P2) & M
+    h ^= h >> 29
+    h = (h * P3) & M
+    return h ^ (h >> 32)
+
+
+def test_hash_xxh64_parity_under_x64():
+    """Under x64 the op is bit-exact XXH64 % mod_by — the reference's
+    bucket values (operators/hash_op.h: XXH64(row, sizeof(int)*d, seed)
+    % mod_by), including the 4-bytes-per-element prefix quirk for int64
+    rows. Covers d spanning the <32B lane/word path and the >=32B
+    stripe path."""
+    import jax
+    import jax.numpy as jnp
+
+    r = np.random.RandomState(7)
+    old_x64 = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
+        for d in (1, 2, 3, 8, 9, 11):
+            x = r.randint(0, 2**31 - 1, (5, d)).astype(np.int64)
+            out = np.asarray(get_op_def("hash").compute(
+                {"X": [jnp.asarray(x, dtype=jnp.int64)]},
+                {"num_hash": 3, "mod_by": 100000})["Out"][0])
+            for row in range(5):
+                # the reference reads sizeof(int)*d bytes of the int64 row
+                data = x[row].tobytes()[:4 * d]
+                for s in range(3):
+                    assert out[row, s, 0] == _py_xxh64(data, s) % 100000, (
+                        d, row, s)
+        # int32 rows: the full row's bytes
+        xi = r.randint(0, 2**31 - 1, (4, 6)).astype(np.int32)
+        out = np.asarray(get_op_def("hash").compute(
+            {"X": [jnp.asarray(xi)]},
+            {"num_hash": 2, "mod_by": 997})["Out"][0])
+        for row in range(4):
+            for s in range(2):
+                assert out[row, s, 0] == \
+                    _py_xxh64(xi[row].tobytes(), s) % 997
+    finally:
+        jax.config.update("jax_enable_x64", old_x64)
+
+
 def test_hash_layer():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):

@@ -165,3 +165,52 @@ def test_fc_fuse_pass_parity():
         (a,) = exe.run(main, feed={"x": xv}, fetch_list=[h])
         (b,) = exe.run(infer, feed={"x": xv}, fetch_list=[h.name])
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_fc_fuse_skips_unsafe_matches():
+    """Guards (advisor round-4 finding): no fusion when the bias is
+    produced BETWEEN the mul and the add (the fc would read it before it
+    exists), when the intermediate is a fetch target, or when it is
+    persistable."""
+    import numpy as np
+
+    from paddle_tpu import passes
+
+    # late-produced bias: mul -> (bias = reduce_sum(x)) -> add
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 6], append_batch_size=False)
+        w = layers.create_parameter([6, 3], "float32", name="w_late")
+        block = main.global_block()
+        pre = block.create_var(name="pre", shape=(4, 3), dtype="float32")
+        block.append_op("mul", inputs={"X": [x.name], "Y": [w.name]},
+                        outputs={"Out": [pre.name]})
+        bias = layers.slice(layers.reduce_sum(x, dim=0), axes=[0],
+                            starts=[0], ends=[3])   # produced AFTER mul
+        out = block.create_var(name="late_out", shape=(4, 3),
+                               dtype="float32")
+        block.append_op("elementwise_add",
+                        inputs={"X": [pre.name], "Y": [bias.name]},
+                        outputs={"Out": [out.name]}, attrs={"axis": -1})
+    before = [o.type for o in main.global_block().ops]
+    passes.apply_pass("fc_fuse", main)
+    assert [o.type for o in main.global_block().ops] == before
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        xv = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+        (r,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+        assert np.isfinite(np.asarray(r)).all()
+
+    # fetch-target intermediate: stays un-fused so the fetch still works
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x2 = layers.data("x", shape=[4, 6], append_batch_size=False)
+        h2 = layers.fc(x2, 3)
+    infer = main2.clone(for_test=True)
+    mul_out = next(o.outputs["Out"][0]
+                   for o in infer.global_block().ops if o.type == "mul")
+    passes.apply_pass("fc_fuse", infer, fetch_targets=[mul_out])
+    assert [o.type for o in infer.global_block().ops] \
+        == [o.type for o in main2.global_block().ops]
