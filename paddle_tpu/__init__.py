@@ -17,6 +17,7 @@ from paddle_tpu import (  # noqa: F401
     framework,
     initializer,
     layers,
+    metrics,
     optimizer,
     regularizer,
     unique_name,

@@ -9,10 +9,14 @@ throughput-oriented, so synthetic data measures the same compute.
 
 from paddle_tpu.dataset import (  # noqa: F401
     cifar,
+    conll05,
     flowers,
     imagenet,
     imdb,
     mnist,
+    movielens,
+    sentiment,
     uci_housing,
+    wmt14,
     wmt16,
 )

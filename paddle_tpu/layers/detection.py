@@ -261,16 +261,49 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
 
 def detection_map(detect_res, label, class_num, background_label=0,
                   overlap_threshold=0.5, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
                   ap_version="integral", name=None, **_compat):
-    """Batch mAP scalar (reference: detection.py:738)."""
-    return _op("detection_map", {"DetectRes": detect_res, "Label": label},
-               {"class_num": class_num,
-                "background_label": background_label,
-                "overlap_threshold": overlap_threshold,
-                "evaluate_difficult": evaluate_difficult,
-                "ap_type": ap_version},
-               out_slots=("MAP",), dtypes=("float32",), name=name,
-               stop_gradient=True)
+    """Batch mAP scalar (reference: detection.py:738). With
+    ``has_state``/``input_states``/``out_states`` wired (the
+    metrics.DetectionMAP accumulation path), ``input_states`` is the
+    ``(pos_count [C], true_pos [C, B], false_pos [C, B])`` triple of
+    fixed-size binned accumulator vars (see ops/detection_ops.py
+    detection_map docstring for the static-shape redesign of the
+    reference's LoD states), the same vars are updated in place as
+    ``out_states``, and the return is the ``(batch mAP, accumulated
+    mAP)`` pair — one op computes both, so the metric does not run the
+    greedy matching twice."""
+    attrs = {"class_num": class_num,
+             "background_label": background_label,
+             "overlap_threshold": overlap_threshold,
+             "evaluate_difficult": evaluate_difficult,
+             "ap_type": ap_version}
+    if has_state is None:
+        return _op("detection_map",
+                   {"DetectRes": detect_res, "Label": label}, attrs,
+                   out_slots=("MAP",), dtypes=("float32",), name=name,
+                   stop_gradient=True)
+    from paddle_tpu.framework import default_main_program
+    from paddle_tpu.layer_helper import LayerHelper
+
+    pos_count, true_pos, false_pos = input_states
+    o_pos, o_tp, o_fp = out_states
+    helper = LayerHelper("detection_map", name=name)
+    accum_map = helper.create_variable_for_type_inference(
+        dtype="float32", stop_gradient=True)
+    batch_map = helper.create_variable_for_type_inference(
+        dtype="float32", stop_gradient=True)
+    attrs["score_bins"] = int(true_pos.shape[-1])
+    default_main_program().current_block().append_op(
+        "detection_map",
+        inputs={"DetectRes": detect_res, "Label": label,
+                "HasState": has_state, "PosCount": pos_count,
+                "TruePos": true_pos, "FalsePos": false_pos},
+        outputs={"MAP": batch_map, "AccumMAP": accum_map,
+                 "AccumPosCount": o_pos, "AccumTruePos": o_tp,
+                 "AccumFalsePos": o_fp},
+        attrs=attrs)
+    return batch_map, accum_map
 
 
 def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,

@@ -197,3 +197,82 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class DetectionMAP:
+    """Detection mean average precision with cross-batch accumulation
+    (reference: metrics.py DetectionMAP:687). Graph-building like the
+    reference: the constructor appends a stateless per-batch
+    ``detection_map`` op plus a stateful accumulated one; fetch both
+    vars from ``get_map_var()`` every batch and ``reset(exe)`` between
+    evaluation passes.
+
+    TPU-native accumulation: the reference grows LoD state tensors
+    batch by batch (dynamic shapes); here the states are FIXED-SIZE
+    per-class score-binned TP/FP histograms plus positive counts
+    (ops/detection_ops.py detection_map docstring), so the whole metric
+    stays inside one static XLA program. ``detect_res`` rows are
+    (label, score, x1, y1, x2, y2) with label < 0 padding — the dense
+    analog of the reference's LoD detection output.
+    """
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral", score_bins=1024):
+        if class_num is None:
+            raise ValueError("class_num is required")
+        from paddle_tpu import layers
+        from paddle_tpu.layers import tensor as tensor_layers
+        from paddle_tpu import unique_name
+
+        gt_label = layers.cast(gt_label, gt_box.dtype)
+        if gt_difficult is not None:
+            gt_difficult = layers.cast(gt_difficult, gt_box.dtype)
+            label = layers.concat([gt_label, gt_difficult, gt_box], axis=-1)
+        else:
+            label = layers.concat([gt_label, gt_box], axis=-1)
+
+        def state(suffix, shape):
+            return tensor_layers.create_global_var(
+                shape=shape, value=0.0, dtype="float32", persistable=True,
+                name=unique_name.generate(f"detection_map_{suffix}"))
+
+        states = (state("accum_pos_count", [class_num]),
+                  state("accum_true_pos", [class_num, score_bins]),
+                  state("accum_false_pos", [class_num, score_bins]))
+        self.has_state = state("has_state", [1])
+        # ONE stateful op computes both the batch and accumulated mAP
+        # (the stateless+stateful pair would run the greedy matching
+        # twice per step)
+        self.cur_map, self.accum_map = layers.detection_map(
+            input, label, class_num, background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult,
+            has_state=self.has_state, input_states=states,
+            out_states=states, ap_version=ap_version)
+        # first accumulating batch after this ADDS to the (zero) states;
+        # later ones add to the running totals (reference: metrics.py
+        # fill_constant of has_state to 1 after the stateful op)
+        layers.fill_constant(shape=[1], value=1.0, dtype="float32",
+                             out=self.has_state)
+
+    def get_map_var(self):
+        """(current mini-batch mAP var, accumulated mAP var)."""
+        return self.cur_map, self.accum_map
+
+    def reset(self, executor, reset_program=None):
+        """Zero the accumulation gate so the next batch restarts the
+        running totals (the reference resets has_state only)."""
+        from paddle_tpu import layers
+        from paddle_tpu.framework import Program, program_guard
+
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(reset_program):
+            var = reset_program.global_block().create_var(
+                name=self.has_state.name, shape=[1], dtype="float32",
+                persistable=True)
+            layers.fill_constant(shape=[1], value=0.0, dtype="float32",
+                                 out=var)
+        executor.run(reset_program)

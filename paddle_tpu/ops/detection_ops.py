@@ -736,13 +736,29 @@ def _detection_map(ins, attrs):
     evaluate_difficult=False, difficult gts neither count toward npos
     nor consume matches (VOC convention). Computes AP per class over the
     whole batch and averages — the stateless analog of the reference's
-    accumulating metric op."""
+    accumulating metric op.
+
+    Cross-batch accumulation (the reference's HasState/PosCount/TruePos/
+    FalsePos plumbing, detection_map_op.cc GetInputPos): the reference
+    grows LoD state tensors with every batch — dynamic shapes, hostile
+    to XLA. Redesigned with FIXED-SIZE states: per-class TP/FP counts
+    binned over ``score_bins`` (default 1024) confidence bins in [0,1]
+    plus a per-class positive count. The accumulated mAP walks the
+    binned PR curve from the top bin down — the binned analog of the
+    exact score sort, within ~1/score_bins of exact. Engaged when the
+    ``TruePos``/``FalsePos``/``PosCount``/``HasState`` inputs are wired
+    (metrics.DetectionMAP does this); batch-level matching stays
+    exact either way."""
     det = _x(ins, "DetectRes").astype(jnp.float32)
     gt = _x(ins, "Label").astype(jnp.float32)
     class_num = int(attrs["class_num"])
     overlap = float(attrs.get("overlap_threshold", 0.5))
     ap_type = attrs.get("ap_type", "integral")
     evaluate_difficult = bool(attrs.get("evaluate_difficult", True))
+    has_state = _x(ins, "HasState")
+    with_states = has_state is not None
+    n_bins = int(attrs.get("score_bins", 1024))
+    tp_hists, fp_hists, nposs = [], [], []
     n, d = det.shape[:2]
     g = gt.shape[1]
     gt_boxes = gt[..., -4:]
@@ -796,11 +812,54 @@ def _detection_map(ins, attrs):
             drec = jnp.diff(jnp.concatenate([jnp.zeros((1,)), rec]))
             ap = jnp.sum(prec * drec * alive)
         aps.append(jnp.where(npos > 0, ap, -1.0))
+
+        if with_states:
+            # score-binned TP/FP counts for the fixed-size accumulator
+            # states (see docstring)
+            w_alive = jnp.where(dmask, 1.0, 0.0).reshape(-1)
+            bins = jnp.clip((sc_flat * n_bins).astype(jnp.int32),
+                            0, n_bins - 1)
+            w_tp = tp_flat.astype(jnp.float32) * w_alive
+            tp_hists.append(jnp.zeros((n_bins,)).at[bins].add(w_tp))
+            fp_hists.append(jnp.zeros((n_bins,)).at[bins].add(
+                (1.0 - tp_flat.astype(jnp.float32)) * w_alive))
+        nposs.append(npos.astype(jnp.float32))
     aps = jnp.stack(aps)
     have = aps >= 0
     m_ap = jnp.sum(jnp.where(have, aps, 0.0)) / jnp.maximum(
         jnp.sum(have), 1)
-    return {"MAP": [m_ap.astype(jnp.float32)]}
+    out = {"MAP": [m_ap.astype(jnp.float32)]}
+
+    if with_states:
+        tp_hist = jnp.stack(tp_hists)                  # [C, B]
+        fp_hist = jnp.stack(fp_hists)
+        npos_v = jnp.stack(nposs)                      # [C]
+        has = has_state.reshape(()).astype(jnp.float32)
+        tp_acc = tp_hist + has * _x(ins, "TruePos").astype(jnp.float32)
+        fp_acc = fp_hist + has * _x(ins, "FalsePos").astype(jnp.float32)
+        npos_acc = npos_v + has * _x(ins, "PosCount").astype(jnp.float32)
+        # accumulated mAP from the binned PR curve: walk bins from the
+        # highest score down (the binned analog of the exact score sort)
+        ctp = jnp.cumsum(tp_acc[:, ::-1], axis=1)      # [C, B]
+        cfp = jnp.cumsum(fp_acc[:, ::-1], axis=1)
+        prec = ctp / jnp.maximum(ctp + cfp, 1.0)
+        rec = ctp / jnp.maximum(npos_acc[:, None], 1.0)
+        if ap_type == "11point":
+            pts = [jnp.max(jnp.where(rec >= t, prec, 0.0), axis=1)
+                   for t in [i / 10.0 for i in range(11)]]
+            acc_aps = sum(pts) / 11.0
+        else:
+            drec = jnp.diff(
+                jnp.concatenate([jnp.zeros((class_num, 1)), rec], 1), axis=1)
+            acc_aps = jnp.sum(prec * drec, axis=1)
+        have_a = npos_acc > 0
+        acc_map = jnp.sum(jnp.where(have_a, acc_aps, 0.0)) / jnp.maximum(
+            jnp.sum(have_a), 1)
+        out["AccumMAP"] = [acc_map.astype(jnp.float32)]
+        out["AccumTruePos"] = [tp_acc]
+        out["AccumFalsePos"] = [fp_acc]
+        out["AccumPosCount"] = [npos_acc]
+    return out
 
 
 def _point_in_polygon(px, py, verts, n_valid):

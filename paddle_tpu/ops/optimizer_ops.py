@@ -264,3 +264,78 @@ def _proximal_adagrad(ins, attrs):
     if l1 > 0:
         prox = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
     return {"ParamOut": [prox / (1.0 + lr * l2)], "MomentOut": [m_new]}
+
+
+@register_op("dgc_momentum", no_grad=True)
+def _dgc_momentum(ins, attrs):
+    """Fused DGC + momentum update (reference: operators/dgc_op.h
+    compress stage + the momentum op that consumes the sparse-allreduced
+    gradient; sparse_all_reduce_op_handle.h:30). One op instead of the
+    reference's dgc -> sparse allreduce -> momentum chain: the compress /
+    exchange / decode happens in paddle_tpu.parallel.dgc, and the
+    decoded gradient immediately feeds the velocity update, all inside
+    the same XLA program.
+
+    When a data axis is in SPMD scope the (index, value) exchange runs
+    as a real all_gather over that axis inside shard_map with
+    combine='mean' — in the GSPMD whole-program path the incoming
+    gradient is already globally reduced, so every worker sends the same
+    selection and the mean restores the right magnitude. The
+    sum-combining local-gradient form is exercised directly through
+    parallel.dgc.dgc_step in a manually shard_mapped step."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.core import interp as _interp
+    from paddle_tpu.parallel import dgc as _dgc
+
+    p, g = _g(ins, "Param"), _g(ins, "Grad")
+    u, v = _g(ins, "U"), _g(ins, "V")
+    vel = _g(ins, "Velocity")
+    lr = _g(ins, "LearningRate").reshape(()).astype(p.dtype)
+    step = _g(ins, "CurrentStep").reshape(())
+    mu = float(attrs.get("mu", 0.9))
+    use_nesterov = bool(attrs.get("use_nesterov", False))
+    sparsity = tuple(attrs.get("sparsity", (0.999,)))
+    rampup_begin = float(attrs.get("rampup_begin_step", 0.0))
+    rampup = float(attrs.get("rampup_step", 1.0))
+    clip_norm = attrs.get("local_grad_clip_norm", None)
+
+    g = g.astype(jnp.float32)
+    if clip_norm is not None:
+        g = _dgc.clip_by_norm_rampup(
+            g, step, clip_norm=float(clip_norm),
+            rampup_begin_step=rampup_begin)
+
+    ctx = _interp.spmd_ctx()
+    if ctx is not None and ctx.data_axis is not None:
+        # composed (slice, dp) tuples gather over the product axis —
+        # one exchange spanning DCN x ICI, like the 2-level allreduce
+        axis = ctx.data_axis
+
+        def _exchange(g_, u_, v_, step_):
+            return _dgc.dgc_step(
+                g_, u_, v_, step_, momentum=mu, sparsity=sparsity,
+                rampup_begin_step=rampup_begin, rampup_step=rampup,
+                use_nesterov=use_nesterov, axis=axis, combine="mean")
+
+        # replicated in/out: the exchange is over the axis name only
+        dec, u_new, v_new = jax.shard_map(
+            _exchange, mesh=ctx.mesh,
+            in_specs=(P(), P(), P(), P()),
+            out_specs=(P(), P(), P()),
+        )(g, u, v, step)
+    else:
+        dec, u_new, v_new = _dgc.dgc_step(
+            g, u, v, step, momentum=mu, sparsity=sparsity,
+            rampup_begin_step=rampup_begin, rampup_step=rampup,
+            use_nesterov=use_nesterov, axis=None)
+
+    dec = dec.astype(p.dtype)
+    vel_new = mu * vel + dec
+    if use_nesterov:
+        p_new = p - (dec + mu * vel_new) * lr
+    else:
+        p_new = p - lr * vel_new
+    return {"ParamOut": [p_new], "VelocityOut": [vel_new],
+            "UOut": [u_new.astype(u.dtype)], "VOut": [v_new.astype(v.dtype)]}

@@ -174,3 +174,18 @@ def _fake_qdq_moving_average_abs_max(ins, attrs):
     x = _x(ins)
     outs["Out"] = [outs["Out"][0].astype(x.dtype)]  # _ste promotes via
     return outs                                     # the f32 scale
+
+
+@register_op("quantize_dequantize_static", no_grad=True)
+def _quantize_dequantize_static(ins, attrs):
+    """Static-scale symmetric quantize-dequantize: the inference-time
+    form of the fake-quant family where the scale is a CONSTANT baked
+    by activation-range calibration (reference:
+    quantization_pass.py:541 QuantizationFreezePass — scales collected
+    from warmup data become attrs, no scale state vars). Serving
+    numerics match int8 deployment while staying XLA-fusable fp32."""
+    x = _x(ins)
+    qmax = _qmax(attrs)
+    scale = float(attrs.get("scale", 1.0)) or 1.0
+    q = jnp.clip(jnp.round(x / scale * qmax), -qmax, qmax)
+    return {"Out": [q * (scale / qmax)]}

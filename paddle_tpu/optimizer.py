@@ -337,6 +337,102 @@ class MomentumOptimizer(Optimizer):
         )
 
 
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Momentum with Deep Gradient Compression (reference:
+    optimizer.py:696 DGCMomentumOptimizer; paper arXiv:1712.01887).
+    Gradients are momentum-corrected into residual accumulators, only
+    the top-k entries are exchanged each step (allgather of
+    (index, value) pairs over the data/slice axis — see
+    parallel/dgc.py for the TPU collective design and the static-k
+    divergence note), and the rest accumulate locally until large
+    enough to send. Sparsity ramps per ``sparsity``/``rampup_step``
+    after ``rampup_begin_step``; before that the update is exactly
+    dense momentum.
+
+    Reference parity notes: parameters under 16384 elements or with
+    non-fp32 dtype stay on the dense momentum path (the reference's
+    _append_dgc_ops gate); ``local_grad_clip_norm`` clips the
+    pre-compression gradient to ``local_grad_clip_norm /
+    num_trainers**2`` past rampup (dgc_clip_by_norm_op.h). Static
+    graph only, like the reference."""
+
+    _DGC_MIN_NUMEL = 16384
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, momentum, use_nesterov,
+                         regularization, name)
+        self._sparsity = list(sparsity)
+        self._rampup_begin_step = float(rampup_begin_step)
+        self._rampup_step = float(rampup_step)
+        self._clip_norm = None
+        if local_grad_clip_norm is not None:
+            if not isinstance(num_trainers, int) or num_trainers <= 0:
+                raise ValueError(
+                    "local_grad_clip_norm needs num_trainers (the world "
+                    "size the clip is scaled by)")
+            self._clip_norm = float(local_grad_clip_norm) / (
+                num_trainers * num_trainers)
+        self._step_var = None
+
+    def _dgc_eligible(self, param) -> bool:
+        numel = 1
+        for d in param.shape or ():
+            numel *= int(d)
+        return (numel >= self._DGC_MIN_NUMEL
+                and str(param.dtype) in ("float32", "FP32"))
+
+    def _create_accumulators(self, block, parameters):
+        from paddle_tpu.layers import tensor
+
+        super()._create_accumulators(block, parameters)
+        for p in parameters:
+            if self._dgc_eligible(p):
+                self._add_accumulator("dgc_u", p)
+                self._add_accumulator("dgc_v", p)
+        if self._step_var is None:
+            # the reference's kDGCCounterName global counter: starts at
+            # -1, a prepended increment makes it 0 on the first step
+            self._step_var = tensor.create_global_var(
+                shape=[1], value=-1.0, dtype="float32", persistable=True,
+                name=unique_name.generate("dgc_counter"))
+            block._prepend_op(
+                "increment", inputs={"X": [self._step_var.name]},
+                outputs={"Out": [self._step_var.name]},
+                attrs={"step": 1.0})
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        if not self._dgc_eligible(p):
+            return super()._append_optimize_op(block, param_and_grad)
+        v = self._get_accumulator("velocity", p)
+        u_acc = self._get_accumulator("dgc_u", p)
+        v_acc = self._get_accumulator("dgc_v", p)
+        attrs = {"mu": self._momentum,
+                 "use_nesterov": self._use_nesterov,
+                 "sparsity": list(self._sparsity),
+                 "rampup_begin_step": self._rampup_begin_step,
+                 "rampup_step": self._rampup_step}
+        if self._clip_norm is not None:
+            attrs["local_grad_clip_norm"] = self._clip_norm
+        block.append_op(
+            "dgc_momentum",
+            inputs={"Param": p, "Grad": g, "Velocity": v, "U": u_acc,
+                    "V": v_acc, "LearningRate": self._param_lr(p),
+                    "CurrentStep": self._step_var},
+            outputs={"ParamOut": p.name, "VelocityOut": v.name,
+                     "UOut": u_acc.name, "VOut": v_acc.name},
+            attrs=attrs,
+        )
+
+    def _dygraph_build(self, params):
+        raise NotImplementedError(
+            "DGCMomentumOptimizer is static-graph only (as in the "
+            "reference); use MomentumOptimizer in dygraph mode")
+
+
 class LarsMomentumOptimizer(Optimizer):
     def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
                  lars_weight_decay=0.0005, regularization=None, name=None):
@@ -622,6 +718,7 @@ class AdadeltaOptimizer(Optimizer):
 # Short aliases matching the reference's public names.
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
+DGCMomentum = DGCMomentumOptimizer
 Adam = AdamOptimizer
 AdamW = AdamWOptimizer
 Adagrad = AdagradOptimizer

@@ -1,0 +1,101 @@
+"""Activation-range int8 PTQ calibration (reference:
+contrib/int8_inference/utility.py Calibrator +
+contrib/slim/quantization/quantization_pass.py:541,836): collect
+activation abs-max over warmup batches, bake static QDQ into the
+inference program, export/load an int8 artifact, and check the
+accuracy delta vs float serving."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.slim.calibration import (Calibrator, _kl_scale,
+                                         load_int8_inference_model,
+                                         save_int8_inference_model)
+
+
+def _train_mnist_mlp(steps=30):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 9
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[784])
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, 64, act="relu")
+        logits = layers.fc(h, 10)
+        infer = main.clone(for_test=True)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            x = rng.normal(0, 1, (32, 784)).astype(np.float32)
+            y = np.argmax(x[:, :10], 1)[:, None].astype(np.int64)
+            exe.run(main, feed={"img": x, "label": y}, fetch_list=[loss])
+    return infer, logits, exe, scope, rng
+
+
+def test_calibrate_freeze_export_load_accuracy(tmp_path):
+    infer, logits, exe, scope, rng = _train_mnist_mlp()
+    with fluid.scope_guard(scope):
+        calib = Calibrator(infer, exe, scope=scope, algo="abs_max")
+        # both matmuls' activation inputs are calibrated
+        assert len(calib.activation_names) >= 2
+        for _ in range(4):
+            calib.sample({"img": rng.normal(0, 1, (32, 784)).astype(
+                np.float32)})
+        scales = calib.compute_scales()
+        assert all(s > 0 for s in scales.values())
+
+        frozen = calib.freeze()
+        f_types = [o.type for o in frozen.global_block().ops]
+        assert f_types.count("quantize_dequantize_static") == len(scales)
+        # original program untouched
+        assert "quantize_dequantize_static" not in [
+            o.type for o in infer.global_block().ops]
+
+        save_int8_inference_model(str(tmp_path / "int8"), ["img"],
+                                  [logits], exe, infer, calib, scope=scope)
+
+    # artifact shape: int8 params, no fp32 params file
+    import os
+    assert os.path.exists(tmp_path / "int8" / "__params_int8__.npz")
+    assert not os.path.exists(tmp_path / "int8" / "__params__.npz")
+    qs = np.load(tmp_path / "int8" / "__params_int8__.npz")
+    assert all(qs[n].dtype == np.int8 for n in qs.files)
+
+    # load into a FRESH scope and compare against float serving
+    x_eval = rng.normal(0, 1, (64, 784)).astype(np.float32)
+    with fluid.scope_guard(scope):
+        (f_logits,) = exe.run(infer, feed={"img": x_eval},
+                              fetch_list=[logits])
+    scope2 = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = load_int8_inference_model(
+            str(tmp_path / "int8"), exe2, scope=scope2)
+        assert feeds == ["img"]
+        (q_logits,) = exe2.run(prog, feed={"img": x_eval},
+                               fetch_list=fetches)
+    f_logits, q_logits = np.asarray(f_logits), np.asarray(q_logits)
+    # int8 artifact serving is quantized-but-close: top-1 agreement
+    agree = (np.argmax(f_logits, 1) == np.argmax(q_logits, 1)).mean()
+    assert agree >= 0.95, agree
+    err = np.abs(f_logits - q_logits).max() / np.abs(f_logits).max()
+    assert 0 < err < 0.15, err  # quantization error present but bounded
+
+
+def test_kl_scale_clips_outliers():
+    """The KL algo picks a threshold below abs-max for heavy-tailed
+    data (the reference's 'KL' option) and equals-ish abs-max for
+    uniform data."""
+    rng = np.random.RandomState(1)
+    body = rng.normal(0, 1, (10000,)).astype(np.float32)
+    spiked = np.concatenate([body, [80.0]]).astype(np.float32)
+    s = _kl_scale([spiked])
+    assert s < 40.0, s                      # outlier clipped away
+    flat = rng.uniform(-1, 1, (10000,)).astype(np.float32)
+    s2 = _kl_scale([flat])
+    assert s2 > 0.5, s2

@@ -307,6 +307,7 @@ def test_detection_layers_build():
         assert boxes.shape[-1] == 4 and anchors.shape[-1] == 4
 
 
+@pytest.mark.full
 def test_ssd_model_trains():
     from paddle_tpu.models import ssd
 
@@ -388,6 +389,7 @@ def test_generate_mask_labels_dense():
     assert (masks[0, 2:] == -1).all()
 
 
+@pytest.mark.full
 def test_generate_mask_labels_no_fg():
     n, g, q, v, r, m, c = 1, 1, 1, 6, 3, 4, 2
     im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
@@ -408,3 +410,44 @@ def test_generate_mask_labels_no_fg():
     assert count[0] == 1          # one bg roi stand-in
     assert has[0, 0] == 0 and (has[0, 1:] == -1).all()
     assert (masks[0, 0] == -1).all()   # all-ignore mask
+
+
+def test_detection_map_metric_class_accumulates():
+    """metrics.DetectionMAP (reference: metrics.py:687): per-batch mAP
+    plus fixed-size binned cross-batch accumulation, reset via
+    has_state."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = layers.data("det", shape=[1, 2, 6], append_batch_size=False,
+                          stop_gradient=True)
+        gtl = layers.data("gtl", shape=[1, 1, 1], append_batch_size=False,
+                          stop_gradient=True)
+        gtb = layers.data("gtb", shape=[1, 1, 4], append_batch_size=False,
+                          stop_gradient=True)
+        m = fluid.metrics.DetectionMAP(det, gtl, gtb, class_num=1)
+        cur, accum = m.get_map_var()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    gt_l = np.zeros((1, 1, 1), np.float32)
+    gt_b = np.array([[[10, 10, 20, 20]]], np.float32)
+    hit = np.array([[[0, 0.9, 10, 10, 20, 20],
+                     [-1, 0, 0, 0, 0, 0]]], np.float32)
+    miss = np.array([[[0, 0.8, 40, 40, 50, 50],
+                      [-1, 0, 0, 0, 0, 0]]], np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fd = {"det": hit, "gtl": gt_l, "gtb": gt_b}
+        c1, a1 = exe.run(main, feed=fd, fetch_list=[cur, accum])
+        assert float(np.asarray(c1)) == pytest.approx(1.0, abs=1e-3)
+        assert float(np.asarray(a1)) == pytest.approx(1.0, abs=1e-3)
+        # second batch misses: cur drops to 0, accumulated is the
+        # 2-batch PR curve (1 TP at 0.9, 1 FP at 0.8, 2 positives):
+        # integral AP = 0.5
+        fd2 = {"det": miss, "gtl": gt_l, "gtb": gt_b}
+        c2, a2 = exe.run(main, feed=fd2, fetch_list=[cur, accum])
+        assert float(np.asarray(c2)) == pytest.approx(0.0, abs=1e-3)
+        assert float(np.asarray(a2)) == pytest.approx(0.5, abs=1e-2)
+        # reset: the next batch starts a fresh accumulation
+        m.reset(exe)
+        c3, a3 = exe.run(main, feed=fd, fetch_list=[cur, accum])
+        assert float(np.asarray(a3)) == pytest.approx(1.0, abs=1e-3)

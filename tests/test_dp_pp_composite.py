@@ -25,6 +25,7 @@ def _build(n_layer):
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+@pytest.mark.full
 def test_dp2_pp4_single_program_parity():
     n_layer = 4
     losses = {}

@@ -7,6 +7,7 @@ reduces the parameter cotangents across shards during the taped backward
 parity against the unwrapped single-device eager run.
 """
 
+import pytest
 import numpy as np
 
 import paddle_tpu as fluid
@@ -78,6 +79,7 @@ def _train(batches, wrap):
     return out
 
 
+@pytest.mark.full
 def test_dataparallel_matches_single_device():
     batches = _batches()
     single = _train(batches, wrap=False)

@@ -230,6 +230,7 @@ def _py_xxh64(data: bytes, seed: int) -> int:
     return h ^ (h >> 32)
 
 
+@pytest.mark.full
 def test_hash_xxh64_parity_under_x64():
     """Under x64 the op is bit-exact XXH64 % mod_by — the reference's
     bucket values (operators/hash_op.h: XXH64(row, sizeof(int)*d, seed)

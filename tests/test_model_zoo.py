@@ -1,12 +1,14 @@
 """Model-zoo smoke/training tests for SE-ResNeXt, LSTM NMT seq2seq, and
 BERT (reference acceptance style: tests/book + benchmark model smoke)."""
 
+import pytest
 import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.models import bert, se_resnext, seq2seq
 
 
+@pytest.mark.full
 def test_se_resnext50_trains_one_step():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):

@@ -52,6 +52,7 @@ def test_gpipe_eight_stages():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.full
 def test_gpipe_gradients_match_sequential():
     """jax.grad flows through ppermute/scan: pipeline grads == sequential
     grads, so the Program-IR autodiff can ride the pipeline unchanged."""

@@ -49,6 +49,7 @@ def _restore(snap):
         fluid.global_scope().set(k, v)
 
 
+@pytest.mark.full
 def test_pipeline_scan_loss_parity():
     """4 layers over a 4-rank pipe axis vs plain lax.scan: same losses.
     (dropout=0: the GPipe microbatch mask stream differs from the

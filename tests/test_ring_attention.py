@@ -38,6 +38,7 @@ def test_ring_attention_causal(mesh):
                                atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.full
 def test_ring_attention_grad_matches(mesh):
     q, k, v = _qkv(2, t=16)
 

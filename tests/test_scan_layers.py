@@ -2,6 +2,7 @@
 and trainability (compile-time optimization; STATUS.md round-3 item
 brought forward)."""
 
+import pytest
 import numpy as np
 
 import paddle_tpu as fluid
@@ -14,6 +15,7 @@ def _cfg():
         d_inner=32, n_head=2, n_layer=3, dropout=0.0, label_smooth_eps=0.0)
 
 
+@pytest.mark.full
 def test_scan_build_matches_unrolled_build():
     cfg = _cfg()
     batch = T.make_batch(cfg, 4, 12, 10, seed=0)

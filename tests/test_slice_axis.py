@@ -68,6 +68,7 @@ def test_slice2_dp2_parity_with_single_device():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+@pytest.mark.full
 def test_slice2_within_dp2_tp2_composes():
     """slice x (dp x tp) on 8 devices: the hierarchical-allreduce mesh
     composed with tensor parallelism in one program."""
@@ -107,6 +108,7 @@ def test_slice2_within_dp2_tp2_composes():
 
 
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+@pytest.mark.full
 def test_slice2_dp2_sp2_ring_attention_parity():
     """slice x dp x sp-ring in one program: the shard_map ring-attention
     kernel receives the COMPOSED (slice, data) batch axis through

@@ -52,6 +52,7 @@ def _run_steps(compiled_or_prog, main, startup, model, n_steps=2):
     return losses, scope
 
 
+@pytest.mark.full
 def test_dp_tp_loss_parity():
     """4x2 dp x tp full training steps match single-device to tight tol."""
     import jax

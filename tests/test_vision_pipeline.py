@@ -2,6 +2,7 @@
 benchmark/fluid/models/resnet.py, imagenet_reader.py,
 python/paddle/dataset/flowers.py)."""
 
+import pytest
 import numpy as np
 
 import paddle_tpu as fluid
@@ -28,11 +29,12 @@ def test_imagenet_batched_reader():
     assert batches[0]["label"].dtype == np.int64
 
 
+@pytest.mark.full
 def test_resnet50_imagenet_shape_trains_one_step():
     """The bench program (ResNet-50, momentum, AMP) runs a full train
-    step, the loss is finite, and gradients reach the stem conv (the
-    former separate ResNet-18 grads-flow check, merged here so the
-    suite compiles one big conv graph instead of two)."""
+    step with a finite loss and the stem conv moves (full tier: the
+    big conv compile; the smoke-tier conv-net gate is the ResNet-18
+    test below, un-folded from the round-4 merge)."""
     from paddle_tpu.models import resnet
 
     main, startup = fluid.Program(), fluid.Program()
@@ -54,6 +56,40 @@ def test_resnet50_imagenet_shape_trains_one_step():
         (loss,) = exe.run(main, feed=fd, fetch_list=[model["loss"]])
         w_after = np.array(scope.find_var(stem))
     assert np.isfinite(loss).all()
+    assert not np.allclose(w_before, w_after), "no gradient reached the stem"
+
+
+def test_resnet18_trains_and_grads_flow():
+    """Small ResNet-18 end-to-end: steps run, losses stay finite, and the
+    stem conv actually moves (gradients reach the bottom of the network).
+    Convergence on synthetic data in a handful of steps is flaky for conv
+    nets (see verify skill notes), so this checks mechanics, not accuracy."""
+    from paddle_tpu.models import resnet
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("data", shape=[3, 48, 48], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        logits = resnet.resnet_imagenet(img, class_dim=16, depth=18)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Momentum(0.01, momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        stem = [p.name for p in main.all_parameters()
+                if p.shape and len(p.shape) == 4][0]
+        w_before = np.array(scope.find_var(stem))
+        for step in range(3):
+            x = rng.uniform(-1, 1, (4, 3, 48, 48)).astype(np.float32)
+            y = rng.randint(0, 16, (4, 1)).astype(np.int64)
+            (l,) = exe.run(main, feed={"data": x, "label": y},
+                           fetch_list=[loss])
+            losses.append(float(l))
+        w_after = np.array(scope.find_var(stem))
+    assert np.isfinite(losses).all()
     assert not np.allclose(w_before, w_after), "no gradient reached the stem"
 
 
