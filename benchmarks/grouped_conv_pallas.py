@@ -1,0 +1,140 @@
+"""Pallas grouped-conv attempt (round 5, VERDICT item 1c).
+
+Strategy: the MXU cannot contract per-group [M, 9*cg] x [9*cg, cg]
+without idling (36/128 K-fill, 4/128 N-fill at cg=4), and ANY matmul
+formulation that packs 32 groups' outputs into the 128-lane dim is
+forced block-diagonal (LHS K-lanes are shared across output lanes), so
+the minimum MXU work for a 128-channel chunk is 9 dense [M,128]x[128,128]
+passes — identical FLOPs to a dense conv, but with weights resident in
+VMEM and the im2col halo shifts done on-chip. That bound is 601 us fwd
+at s0 vs XLA's measured grouped-conv 957 us (grouped_conv_bench.py), so
+the best possible Pallas win on the worst stage is ~1.6x fwd.
+
+Kernel: grid (N, C/128); per step the padded input slab
+[1, H+2, W+2, 128] sits in VMEM, weights [9, 128, 128] (block-diagonal,
+built host-side) sit in VMEM, and 9 tap-shifted dot_generals accumulate
+the [H, W, 128] output in fp32.
+
+dgrad of a stride-1 same-pad conv is the same kernel with
+spatially-flipped, IO-transposed block-diag weights, so a fwd win would
+carry to the backward at equal cost; wgrad stays on XLA.
+
+Run: python benchmarks/grouped_conv_pallas.py
+"""
+
+from __future__ import annotations
+
+import functools
+import glob
+import gzip
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ITERS = 12
+
+
+def _kernel(x_ref, w_ref, o_ref, acc, *, h, w):
+    acc[:] = jnp.zeros_like(acc)
+    for t in range(9):
+        dy, dx = t // 3, t % 3
+        xs = x_ref[0, dy:dy + h, dx:dx + w, :]
+        acc[:] += lax.dot_general(
+            xs, w_ref[0, t], (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    o_ref[0] = acc[:].astype(o_ref.dtype)
+
+
+def grouped_conv_pallas(x, wbd):
+    """x: [N, H, W, C] bf16 (unpadded); wbd: [C//128, 9, 128, 128]
+    block-diagonal per 128-channel chunk. Stride 1, SAME padding."""
+    n, h, w, c = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    kern = functools.partial(_kernel, h=h, w=w)
+    return pl.pallas_call(
+        kern,
+        grid=(n, c // 128),
+        in_specs=[
+            pl.BlockSpec((1, h + 2, w + 2, 128),
+                         lambda i, cc: (i, 0, 0, cc)),
+            pl.BlockSpec((1, 9, 128, 128), lambda i, cc: (cc, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, w, 128), lambda i, cc: (i, 0, 0, cc)),
+        out_shape=jax.ShapeDtypeStruct((n, h, w, c), x.dtype),
+        scratch_shapes=[pltpu.VMEM((h, w, 128), jnp.float32)],
+    )(xp, wbd)
+
+
+def make_blockdiag(wg, c, cg):
+    """[3, 3, cg, C] HWIO grouped -> [C//128, 9, 128, 128] block-diag."""
+    g = c // cg
+    out = np.zeros((c // 128, 9, 128, 128), np.float32)
+    wg = np.asarray(wg, np.float32).reshape(9, cg, c)
+    for gi in range(g):
+        chunk = (gi * cg) // 128
+        base = gi * cg - chunk * 128
+        blk = wg[:, :, gi * cg:(gi + 1) * cg]  # [9, cg_in, cg_out]
+        out[chunk, :, base:base + cg, base:base + cg] = blk
+    return jnp.asarray(out, jnp.bfloat16)
+
+
+def conv_ref(x, wg, groups):
+    return lax.conv_general_dilated(
+        x, wg, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups)
+
+
+def trace_s(tag, fn, *args):
+    o = fn(*args)
+    jax.block_until_ready(o)
+    d = f"/tmp/perf/gp_{tag}"
+    with jax.profiler.trace(d):
+        o = fn(*args)
+        jax.block_until_ready(o)
+    fs = sorted(glob.glob(f"{d}/**/*.trace.json.gz", recursive=True))
+    ev = json.load(gzip.open(fs[-1]))["traceEvents"]
+    return sum(e.get("dur", 0) for e in ev
+               if e.get("ph") == "X" and e.get("pid") == 3
+               and e.get("tid") == 3) * 1e-6
+
+
+def chain(body):
+    @jax.jit
+    def run(x):
+        return lax.fori_loop(0, ITERS, lambda i, x: body(x), x)
+    return run
+
+
+def main():
+    r = np.random.RandomState(0)
+    for tag, n, h, w_, c, cg in [("s0", 128, 56, 56, 128, 4),
+                                 ("s1", 128, 28, 28, 256, 8)]:
+        x = jnp.asarray(r.randn(n, h, w_, c) * 0.5, jnp.bfloat16)
+        wg = jnp.asarray(r.randn(3, 3, cg, c) / np.sqrt(9 * cg),
+                         jnp.bfloat16)
+        wbd = make_blockdiag(wg, c, cg)
+
+        y_ref = conv_ref(x, wg, 32)
+        y_pl = grouped_conv_pallas(x, wbd)
+        err = float(jnp.max(jnp.abs(y_ref.astype(jnp.float32) -
+                                    y_pl.astype(jnp.float32))))
+        print(f"{tag}: max abs err pallas vs lax grouped = {err:.4f}")
+
+        t_ref = trace_s(f"{tag}_ref", chain(lambda x: conv_ref(x, wg, 32)),
+                        x) / ITERS
+        t_pl = trace_s(f"{tag}_pl",
+                       chain(lambda x: grouped_conv_pallas(x, wbd)),
+                       x) / ITERS
+        print(f"{tag}: XLA grouped {t_ref*1e6:8.1f} us | "
+              f"pallas block-diag {t_pl*1e6:8.1f} us "
+              f"({t_ref/t_pl:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

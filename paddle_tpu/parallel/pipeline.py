@@ -163,11 +163,24 @@ def gpipe(
         )
 
     mb_spec = P(None, data_axis) if data_axis else P()
+    # tp x pp composition: mesh axes not named here (e.g. a 'model'
+    # tensor-parallel axis) stay AUTO — GSPMD partitions the stage body
+    # over them from the stacked weights' own shardings (strategy rules
+    # like pipeline_tp_rules put P(pipe, None, model) on a stacked
+    # column-parallel weight: dim 0 is the manual stage axis this
+    # shard_map slices, the model dim rides through as an auto-axis
+    # sharding and GSPMD inserts the row-parallel all-reduces inside the
+    # per-tick stage computation).
+    manual = {pipe_axis}
+    if data_axis:
+        manual |= (set(data_axis) if isinstance(data_axis, (tuple, list))
+                   else {data_axis})
     out = jax.shard_map(
         local,
         mesh=mesh,
         in_specs=(param_specs, mb_spec, mb_spec),
         out_specs=mb_spec,
+        axis_names=frozenset(manual),
     )(stage_params, x_m, streams_m)
     return out.reshape((b,) + x.shape[1:])
 

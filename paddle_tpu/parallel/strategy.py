@@ -158,6 +158,37 @@ def pipeline_rules(pipe_axis: str = "pipe") -> List[ShardingRule]:
     return [ShardingRule(r"_stacked(_|$)", P(pipe_axis))]
 
 
+def pipeline_tp_rules(pipe_axis: str = "pipe",
+                      model_axis: str = "model") -> List[ShardingRule]:
+    """tp INSIDE a pipeline stage (the composition every real
+    large-model config uses — SURVEY.md §2.3 final row): stacked-layer
+    weights ([L, ...] from scan-over-layers builds) shard dim 0 over the
+    pipe axis AND their Megatron dim over the model axis. The pipe dim
+    is sliced manually by gpipe's shard_map; the model dim is an AUTO
+    axis GSPMD partitions inside the stage body (parallel/pipeline.py).
+
+    Key naming comes from _enc/_dec_weight_specs: stacked slots keep the
+    per-layer kind in the slot key (qkv/ffn1/q/k/v = column-parallel,
+    out/ffn2 = row-parallel)."""
+    p, m = pipe_axis, model_axis
+    return [
+        # column-parallel: shard the output dim (stacked dim 2 for w)
+        ShardingRule(r"_(qkv|ffn1|self_q|self_k|self_v|q|k|v)\.w_stacked(_|$)",
+                     P(p, None, m)),
+        ShardingRule(r"_(qkv|ffn1|self_q|self_k|self_v|q|k|v)\.b_stacked(_|$)",
+                     P(p, m)),
+        # row-parallel: shard the input dim (stacked dim 1 for w)
+        ShardingRule(r"_(out|self_out|cross_out|ffn2)\.w_stacked(_|$)",
+                     P(p, m, None)),
+        ShardingRule(r"_(out|self_out|cross_out|ffn2)\.b_stacked(_|$)",
+                     P(p)),
+        ShardingRule(r"_stacked(_|$)", P(p)),   # norms etc: pipe only
+        # non-stacked tails (embeddings stay replicated; the vocab
+        # projection column-shards like transformer_rules)
+        ShardingRule(r"proj_colp\.w(_|$)", P(None, m)),
+    ]
+
+
 def transformer_rules(model_axis: str = "model") -> List[ShardingRule]:
     """Megatron-style tensor parallelism for models/transformer.py naming:
 
