@@ -13,10 +13,12 @@ slow inter-slice links or at extreme sparsity).
 
 One deliberate divergence: the reference's ``k`` varies at runtime with
 the sparsity rampup schedule. A dynamic ``k`` would force a dynamic
-output shape on ``top_k`` — hostile to XLA — so the selection width is
-the STATIC maximum k over the schedule and the per-step effective k is
-applied as a mask (entries beyond k contribute zero and are not counted
-as sent). Same trajectory, static shapes.
+output shape on ``top_k`` — hostile to XLA — so the selection runs at
+TWO static widths behind a ``lax.cond`` on the (replicated) step
+counter: the schedule-max width during rampup, the terminal-sparsity
+width once the schedule saturates, with the per-step effective k
+applied as a mask inside each. Same trajectory, static shapes, and the
+steady-state exchange moves only ~n/1000 entries, not the warmup max.
 """
 
 from __future__ import annotations
@@ -29,21 +31,29 @@ import numpy as np
 from jax import lax
 
 
+# The saturation sparsity every schedule converges to past rampup_step
+# (the reference's hard-coded 0.999, dgc_op.h:24). period_sparsity's
+# saturation value, max_k's tail and dgc_step's steady-state gather
+# width k_term all derive from THIS constant — they must agree or the
+# steady-state mask silently truncates the exchange.
+_TERMINAL_SPARSITY = 0.999
+
+
 def period_sparsity(sparsity: Sequence[float], step, rampup_step: float):
     """The reference's get_period_sparcity (dgc_op.h:24): index the
     sparsity list by ``step * len / rampup_step`` (note: GLOBAL step,
-    the reference quirk), saturating at 0.999."""
+    the reference quirk), saturating at _TERMINAL_SPARSITY."""
     sp = jnp.asarray(list(sparsity), jnp.float32)
     idx = (step.astype(jnp.float32) * len(sparsity)
            / float(rampup_step)).astype(jnp.int32)
-    return jnp.where(idx >= len(sparsity), jnp.float32(0.999),
+    return jnp.where(idx >= len(sparsity), jnp.float32(_TERMINAL_SPARSITY),
                      sp[jnp.clip(idx, 0, len(sparsity) - 1)])
 
 
 def max_k(numel: int, sparsity: Sequence[float]) -> int:
     """Static selection width: the largest per-step k the schedule can
-    ask for (plus the saturated 0.999 tail)."""
-    ratios = [1.0 - s for s in sparsity] + [1.0 - 0.999]
+    ask for (plus the saturated terminal tail)."""
+    ratios = [1.0 - s for s in sparsity] + [1.0 - _TERMINAL_SPARSITY]
     return max(1, int(numel * max(ratios)))
 
 
@@ -97,30 +107,53 @@ def dgc_step(
         v2 = vf + u2
 
     kmax = min(max_k(n, sparsity), n)
+    # steady-state width: once the schedule saturates (step >=
+    # rampup_step -> sparsity 0.999), k_eff never exceeds the terminal
+    # k again, so gathering the full schedule-max width forever would
+    # move ~max_ratio*n entries per step in perpetuity (e.g. n/4 with
+    # the paper's 0.75-first warmup) instead of n/1000 — negating the
+    # byte cut dgc_allreduce_bytes models. +1 absorbs the f32-vs-python
+    # rounding of the reference's int cast.
+    k_term = min(n, max(1, int(n * (1.0 - _TERMINAL_SPARSITY))) + 1)
     ratio = 1.0 - period_sparsity(sparsity, step, rampup_step)
     k_eff = jnp.maximum(
         (ratio * n).astype(jnp.int32), 1)            # reference int cast
-    vals, idx = lax.top_k(jnp.abs(v2), kmax)
-    live = jnp.arange(kmax) < k_eff                  # static-width mask
-    sent_vals = jnp.where(live, v2[idx], 0.0)
-    sent_idx = jnp.where(live, idx, 0)               # dead slots add 0.0
 
-    # momentum factor masking: sent positions reset locally (scatter-min
-    # so a dead slot's index-0 placeholder can't overwrite a live zero)
-    keep = jnp.ones((n,), jnp.float32).at[sent_idx].min(
-        jnp.where(live, 0.0, 1.0))
-    u3 = u2 * keep
-    v3 = v2 * keep
+    def _select_exchange(width):
+        _, idx = lax.top_k(jnp.abs(v2), width)
+        live = jnp.arange(width) < jnp.minimum(k_eff, width)
+        sent_vals = jnp.where(live, v2[idx], 0.0)
+        sent_idx = jnp.where(live, idx, 0)           # dead slots add 0.0
 
-    if axis is not None:
-        all_vals = lax.all_gather(sent_vals, axis)   # [W, kmax]
-        all_idx = lax.all_gather(sent_idx, axis)
-        decoded = jnp.zeros((n,), jnp.float32).at[
-            all_idx.reshape(-1)].add(all_vals.reshape(-1))
-        if combine == "mean":
-            decoded = decoded / all_vals.shape[0]
+        # momentum factor masking: sent positions reset locally
+        # (scatter-min so a dead slot's index-0 placeholder can't
+        # overwrite a live zero)
+        keep = jnp.ones((n,), jnp.float32).at[sent_idx].min(
+            jnp.where(live, 0.0, 1.0))
+        u3 = u2 * keep
+        v3 = v2 * keep
+
+        if axis is not None:
+            all_vals = lax.all_gather(sent_vals, axis)   # [W, width]
+            all_idx = lax.all_gather(sent_idx, axis)
+            dec = jnp.zeros((n,), jnp.float32).at[
+                all_idx.reshape(-1)].add(all_vals.reshape(-1))
+            if combine == "mean":
+                dec = dec / all_vals.shape[0]
+        else:
+            dec = jnp.zeros((n,), jnp.float32).at[sent_idx].add(sent_vals)
+        return dec, u3, v3
+
+    if kmax > k_term:
+        # two static widths behind a cond: every rank holds the same
+        # replicated step, so all ranks take the same branch and the
+        # collective is uniform; steady state moves only k_term entries.
+        decoded, u3, v3 = lax.cond(
+            step >= float(rampup_step),
+            lambda: _select_exchange(k_term),
+            lambda: _select_exchange(kmax))
     else:
-        decoded = jnp.zeros((n,), jnp.float32).at[sent_idx].add(sent_vals)
+        decoded, u3, v3 = _select_exchange(kmax)
 
     active = step >= float(rampup_begin_step)
     decoded = jnp.where(active, decoded, gf)

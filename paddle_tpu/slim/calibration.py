@@ -48,6 +48,12 @@ def _kl_scale(samples: List[np.ndarray], bins: int = 2048,
     for s in samples:
         h, _ = np.histogram(np.abs(s), bins=bins, range=(0, amax))
         hist += h
+    return _kl_from_hist(hist, amax, bins, target_bins)
+
+
+def _kl_from_hist(hist: np.ndarray, amax: float, bins: int = 2048,
+                  target_bins: int = 128) -> float:
+    """KL threshold sweep over a prebuilt |x| histogram on (0, amax)."""
     total = hist.sum()
     if total == 0:
         return amax
@@ -107,34 +113,78 @@ class Calibrator:
         block = program.global_block()
         persistable = {n for n, v in block.vars.items()
                        if getattr(v, "persistable", False)}
-        # activation tensors at quantizable boundaries: non-persistable
-        # inputs of the quantizable slots (weights get their scale from
-        # the tensor itself at freeze time, like the reference's
-        # abs_max weight path)
+        # one pass over the quantizable slots partitions their inputs:
+        # non-persistable -> activations to calibrate (weights get their
+        # scale from the tensor itself at freeze time, like the
+        # reference's abs_max weight path); persistable -> the weight
+        # set save_int8_inference_model may snapshot as int8 (the
+        # reference ConvertToInt8Pass quantizes only weights feeding
+        # quantized ops; BN statistics, biases and every other
+        # parameter stay fp32)
         names: List[str] = []
+        wnames: List[str] = []
         for op in block.ops:
             if op.type not in self.op_types:
                 continue
             for slot in self.op_types[op.type]:
                 for n in op.inputs.get(slot, []):
-                    if n and n not in persistable and n not in names:
-                        names.append(n)
+                    if not n:
+                        continue
+                    dst = wnames if n in persistable else names
+                    if n not in dst:
+                        dst.append(n)
         self.activation_names = names
-        self._samples: Dict[str, List[np.ndarray]] = {n: [] for n in names}
+        self.weight_names = wnames
+        # Bounded-memory sampling state: retaining raw activations for
+        # every warmup batch is GBs on a real conv net. abs_max keeps a
+        # running per-tensor max; KL keeps one fine per-batch |x|
+        # histogram (rebinned onto the global amax grid at compute
+        # time — max rebinning error is one fine bin, amax/8192).
+        self._amax: Dict[str, float] = {n: 0.0 for n in names}
+        self._hists: Dict[str, List[Tuple[np.ndarray, float]]] = {
+            n: [] for n in names}
+        self._seen = False
         self._scales: Optional[Dict[str, float]] = None
 
+    _FINE_BINS = 8192
+
     def sample(self, feed: Dict[str, np.ndarray]) -> None:
-        """Run one warmup batch and record the activation tensors."""
+        """Run one warmup batch and record the activation ranges."""
         outs = self.exe.run(self.program, feed=feed,
                             fetch_list=list(self.activation_names),
                             scope=self.scope)
+        self._seen = True
         for name, val in zip(self.activation_names, outs):
-            self._samples[name].append(np.asarray(val))
+            a = np.abs(np.asarray(val, dtype=np.float32))
+            bmax = float(a.max()) if a.size else 0.0
+            self._amax[name] = max(self._amax[name], bmax)
+            if self.algo == "KL":
+                h, _ = np.histogram(a, bins=self._FINE_BINS,
+                                    range=(0, bmax or 1.0))
+                self._hists[name].append((h.astype(np.float64), bmax))
 
     def compute_scales(self) -> Dict[str, float]:
-        fn = _abs_max_scale if self.algo == "abs_max" else _kl_scale
-        self._scales = {n: fn(s) for n, s in self._samples.items() if s}
-        return dict(self._scales)
+        if not self._seen:
+            self._scales = {}
+            return {}
+        if self.algo == "abs_max":
+            self._scales = {n: (m or 1.0) for n, m in self._amax.items()}
+            return dict(self._scales)
+        scales: Dict[str, float] = {}
+        for name, batches in self._hists.items():
+            amax = self._amax[name] or 1.0
+            hist = np.zeros(2048, np.float64)
+            for h, bmax in batches:
+                if bmax <= 0:
+                    continue
+                centers = (np.arange(self._FINE_BINS) + 0.5) * (
+                    bmax / self._FINE_BINS)
+                idx = np.minimum(
+                    (centers / amax * 2048).astype(np.int64), 2047)
+                np.add.at(hist, idx, h)
+            scales[name] = _kl_from_hist(hist, amax)
+        self._scales = scales
+        return dict(scales)
 
     def freeze(self) -> Program:
         """Return a NEW program with static-scale quantize-dequantize
@@ -197,24 +247,36 @@ def save_int8_inference_model(dirname: str, feed_names: Sequence[str],
     with scope_guard(scope):
         io.save_inference_model(dirname, list(feed_names), fetch_targets,
                                 exe, frozen)
-    qweights = quantize_weights_int8(frozen, scope)
-    # overwrite the fp32 params with the int8 artifact
+    # int8-snapshot ONLY the weights of quantizable ops (reference
+    # ConvertToInt8Pass: conv filters / mul weights). Everything else —
+    # BN running mean/variance (tiny dynamic range: symmetric int8
+    # crushes small variances to 0 and rsqrt blows up), biases, and any
+    # other persistable — stays fp32 in the params file.
+    wset = set(calibrator.weight_names)
+    qweights = {n: qs for n, qs in quantize_weights_int8(frozen, scope)
+                .items() if n in wset}
     np.savez(os.path.join(dirname, "__params_int8__.npz"),
              **{n: q for n, (q, _) in qweights.items()})
     meta = {"weight_scales": {n: s for n, (_, s) in qweights.items()},
             "activation_scales": calibrator._scales or {}}
     with open(os.path.join(dirname, "__int8_scales__.json"), "w") as f:
         json.dump(meta, f)
-    os.remove(os.path.join(dirname, "__params__.npz"))
+    # rewrite the fp32 params file without the int8-snapshotted tensors
+    ppath = os.path.join(dirname, io._PARAMS_FILE)
+    fp32 = np.load(ppath)
+    keep = {n: fp32[n] for n in fp32.files if n not in qweights}
+    fp32.close()
+    np.savez(ppath, **keep)
 
 
 def load_int8_inference_model(dirname: str, exe, scope=None):
-    """Load an int8 artifact: dequantize weights into the scope and
-    return (program, feed_names, fetch_vars) like
-    io.load_inference_model (the fp32 params file does not exist in an
-    int8 artifact, so the weights load from __params_int8__.npz)."""
+    """Load an int8 artifact: fp32 params (BN stats, biases, anything
+    not int8-snapshotted) from the params file, int8 weights dequantized
+    via slim.quantization.dequantize_weights; returns (program,
+    feed_names, fetch_vars) like io.load_inference_model."""
     from paddle_tpu import io
     from paddle_tpu.executor import global_scope
+    from paddle_tpu.slim.quantization import dequantize_weights
 
     scope = scope or global_scope()
     with open(os.path.join(dirname, io._MODEL_FILE), "rb") as f:
@@ -223,10 +285,15 @@ def load_int8_inference_model(dirname: str, exe, scope=None):
         io_meta = json.load(f)
     with open(os.path.join(dirname, "__int8_scales__.json")) as f:
         meta = json.load(f)
+    ppath = os.path.join(dirname, io._PARAMS_FILE)
+    if os.path.exists(ppath):
+        fp32 = np.load(ppath)
+        for name in fp32.files:
+            scope.set(name, fp32[name])
+        fp32.close()
     qs = np.load(os.path.join(dirname, "__params_int8__.npz"))
-    for name in qs.files:
-        scale = meta["weight_scales"][name]
-        scope.set(name, qs[name].astype(np.float32) * scale / 127.0)
+    dequantize_weights(
+        {n: (qs[n], meta["weight_scales"][n]) for n in qs.files}, scope)
     fetch_vars = [prog.global_block().var(n)
                   for n in io_meta["fetch_names"]]
     return prog, io_meta["feed_names"], fetch_vars

@@ -59,12 +59,17 @@ def test_calibrate_freeze_export_load_accuracy(tmp_path):
         save_int8_inference_model(str(tmp_path / "int8"), ["img"],
                                   [logits], exe, infer, calib, scope=scope)
 
-    # artifact shape: int8 params, no fp32 params file
+    # artifact shape: int8 snapshot holds ONLY the quantizable-op
+    # weights; everything else (biases here; BN stats in conv nets)
+    # stays fp32 in the params file, with no overlap
     import os
     assert os.path.exists(tmp_path / "int8" / "__params_int8__.npz")
-    assert not os.path.exists(tmp_path / "int8" / "__params__.npz")
     qs = np.load(tmp_path / "int8" / "__params_int8__.npz")
     assert all(qs[n].dtype == np.int8 for n in qs.files)
+    assert set(qs.files) == set(calib.weight_names)
+    fp32 = np.load(tmp_path / "int8" / "__params__.npz")
+    assert not (set(fp32.files) & set(qs.files))
+    assert len(fp32.files) > 0  # the fc biases survived fp32
 
     # load into a FRESH scope and compare against float serving
     x_eval = rng.normal(0, 1, (64, 784)).astype(np.float32)
@@ -99,3 +104,76 @@ def test_kl_scale_clips_outliers():
     flat = rng.uniform(-1, 1, (10000,)).astype(np.float32)
     s2 = _kl_scale([flat])
     assert s2 > 0.5, s2
+
+
+def test_conv_bn_int8_roundtrip(tmp_path):
+    """BN statistics must NOT be int8-quantized: a moving_variance with
+    small entries crushes to 0 under symmetric per-tensor int8 and
+    rsqrt(0+eps) blows the channel up (the ConvertToInt8Pass keeps
+    non-weight params fp32; so do we)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[4, 8, 8], dtype="float32")
+        y = layers.conv2d(img, 8, 3, padding=1, bias_attr=False)
+        y = layers.batch_norm(y, is_test=True, moving_variance_name="bn_moving_var")
+        logits = layers.fc(layers.reshape(y, [-1, 8 * 8 * 8]), 10)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # force a wide-dynamic-range variance: int8 would zero the
+        # small entries
+        bn_var = "bn_moving_var"
+        var = np.asarray(scope.find_var(bn_var)).copy()
+        var[: len(var) // 2] = 1e-4
+        var[len(var) // 2:] = 5.0
+        scope.set(bn_var, var)
+
+        calib = Calibrator(main, exe, scope=scope, algo="abs_max")
+        for _ in range(2):
+            calib.sample({"img": rng.normal(0, 1, (8, 4, 8, 8)).astype(
+                np.float32)})
+        save_int8_inference_model(str(tmp_path / "i8"), ["img"],
+                                  [logits], exe, main, calib, scope=scope)
+        x = rng.normal(0, 1, (16, 4, 8, 8)).astype(np.float32)
+        (ref,) = exe.run(main, feed={"img": x}, fetch_list=[logits])
+
+    qs = np.load(tmp_path / "i8" / "__params_int8__.npz")
+    assert bn_var not in qs.files  # BN variance stayed fp32
+
+    scope2 = fluid.Scope()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope2):
+        prog, feeds, fetches = load_int8_inference_model(
+            str(tmp_path / "i8"), exe2, scope=scope2)
+        np.testing.assert_allclose(np.asarray(scope2.find_var(bn_var)),
+                                   var)  # bit-exact fp32 roundtrip
+        (q_out,) = exe2.run(prog, feed={"img": x}, fetch_list=fetches)
+    ref, q_out = np.asarray(ref), np.asarray(q_out)
+    err = np.abs(ref - q_out).max() / max(np.abs(ref).max(), 1e-6)
+    assert err < 0.2, err  # no rsqrt blow-up from a zeroed variance
+
+
+def test_calibrator_kl_matches_exact_sweep():
+    """The PRODUCTION KL path (bounded-memory per-batch fine histograms
+    rebinned onto the global amax grid in compute_scales) must agree
+    with the exact-from-raw-samples sweep (_kl_scale) to within one
+    sweep quantum (16/2048 of amax)."""
+    infer, logits, exe, scope, rng = _train_mnist_mlp(steps=5)
+    with fluid.scope_guard(scope):
+        calib = Calibrator(infer, exe, scope=scope, algo="KL")
+        raw = {n: [] for n in calib.activation_names}
+        for _ in range(3):
+            feed = {"img": rng.normal(0, 1, (32, 784)).astype(np.float32)}
+            calib.sample(feed)
+            outs = exe.run(infer, feed=feed,
+                           fetch_list=list(calib.activation_names))
+            for n, v in zip(calib.activation_names, outs):
+                raw[n].append(np.asarray(v))
+        scales = calib.compute_scales()
+    assert scales
+    for n, s in scales.items():
+        exact = _kl_scale(raw[n])
+        amax = max(float(np.abs(v).max()) for v in raw[n])
+        assert abs(s - exact) <= amax * 16 / 2048 + 1e-6, (n, s, exact)

@@ -255,3 +255,23 @@ def test_dgc_optimizer_dense_parity_before_rampup():
     # active DGC diverges from dense but still trains
     assert not np.allclose(w_mom, w_now)
     assert l_now[-1] < l_now[0]
+
+
+def test_dgc_steady_state_gather_width():
+    """Past rampup the exchange runs at the TERMINAL width (~n/1000+1),
+    not the schedule max (~n/4 with the paper's warmup): the warmup
+    schedule and a terminal-only schedule must produce identical decoded
+    grads/accumulators once the schedule has saturated."""
+    rng = np.random.RandomState(3)
+    n = 4000
+    g = jnp.asarray(rng.normal(0, 1, (n,)), jnp.float32)
+    u = jnp.asarray(rng.normal(0, 0.1, (n,)), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 0.1, (n,)), jnp.float32)
+    step = jnp.float32(50.0)  # >= rampup_step=10 -> saturated at 0.999
+    warm = dgc.dgc_step(g, u, v, step, momentum=0.9,
+                        sparsity=[0.75, 0.9375, 0.984375, 0.996, 0.999],
+                        rampup_begin_step=0, rampup_step=10)
+    term = dgc.dgc_step(g, u, v, step, momentum=0.9, sparsity=[0.999],
+                        rampup_begin_step=0, rampup_step=10)
+    for a, b in zip(warm, term):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
