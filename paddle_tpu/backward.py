@@ -122,6 +122,24 @@ def append_backward(
         op = block.ops[idx]
         opdef = resolve_op_def(op.type)
         if opdef.no_grad:
+            if op.type == "while" and any(
+                lookup(n)
+                for names in op.outputs.values() for n in names if n
+            ):
+                raise RuntimeError(
+                    "Cannot backprop through a data-dependent `while` "
+                    "loop: XLA's While is not reverse-differentiable, so "
+                    "its gradient would be silently dropped. Either (a) "
+                    "give the loop an iteration bound — "
+                    "While(cond, max_trip_count=N) lowers to a "
+                    "differentiable fixed-trip scan with dead iterations "
+                    "masked — or (b) rewrite the recurrence with "
+                    "layers.StaticRNN / the scan op, the differentiable "
+                    "loop primitives. (The reference trains through "
+                    "while_op via WhileGradOp, "
+                    "operators/controlflow/while_op.cc:43; "
+                    "bounded_while is the TPU-native equivalent.)"
+                )
             continue
 
         out_grads: Dict[str, List[str]] = {}

@@ -145,6 +145,60 @@ class Fleet:
             return []
         return self._client.dead_peers(max_age_ms)
 
+    def barrier_or_dead(self, name: str, max_age_ms: int = 5_000,
+                        poll_ms: int = 100,
+                        timeout_ms: int = 120_000) -> Sequence[str]:
+        """Liveness-guarded barrier — the collective-timeout analog of
+        the reference's grpc deadline on sync barriers. Arrive at
+        ``name``, then wait until EITHER every worker has arrived
+        (returns []) OR some worker's heartbeat ages past
+        ``max_age_ms`` (returns the dead ids without blocking on them).
+        Workers place this before each step's collectives so a peer
+        crash surfaces as a recoverable signal instead of a hang in
+        psum. The caller keeps heartbeating while it polls."""
+        if self._client is None:
+            return []
+        import time as _time
+
+        me = self.worker_index()
+        key = f"fleet/arrive/{name}/{me}"
+        try:
+            self._client.get(key, timeout_ms=0)
+        except TimeoutError:
+            pass  # fresh name, as required
+        else:
+            raise ValueError(
+                f"barrier_or_dead name {name!r} was already used: arrive "
+                f"keys persist in the coordination KV, so reuse would "
+                f"pass instantly on stale arrivals and silently lose the "
+                f"liveness protection. Use a unique name per barrier "
+                f"(e.g. interpolate the step index).")
+        self._client.put(key, b"1")
+        deadline = _time.monotonic() + timeout_ms / 1000.0
+        while True:
+            self._client.heartbeat(f"worker-{me}")
+            missing = []
+            for r in range(self.worker_num()):
+                if r == me:
+                    continue
+                try:
+                    self._client.get(f"fleet/arrive/{name}/{r}",
+                                     timeout_ms=0)
+                except TimeoutError:
+                    missing.append(r)
+            if not missing:
+                return []
+            dead = list(self._client.dead_peers(max_age_ms))
+            dead_missing = [d for d in dead
+                            if any(d == f"worker-{r}" for r in missing)]
+            if dead_missing:
+                return dead_missing
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"barrier_or_dead {name!r}: workers {missing} neither "
+                    f"arrived nor declared dead within {timeout_ms} ms")
+            _time.sleep(poll_ms / 1000.0)
+
     # --- program compilation over the global mesh ---
 
     def mesh(self, shape: Optional[Sequence[int]] = None,

@@ -111,9 +111,19 @@ class While:
     operators/controlflow/while_op.cc:43 -> here ``lax.while_loop``.
     """
 
-    def __init__(self, cond: Variable, is_test: bool = False, name=None):
+    def __init__(self, cond: Variable, is_test: bool = False, name=None,
+                 max_trip_count: Optional[int] = None):
+        """``max_trip_count``: upper bound on iterations. When given, the
+        loop lowers DIFFERENTIABLY (``bounded_while``: a scan over
+        exactly N steps with dead iterations masked through selects), so
+        programs that backprop through a data-dependent loop — which the
+        reference trains via WhileGradOp — work here too, at the cost of
+        always executing N body evaluations. Without it, the loop lowers
+        to XLA While (dynamic trip count, NO gradient — backprop through
+        it raises; use scan/StaticRNN for recurrences)."""
         self.helper = LayerHelper("while", name=name)
         self.cond_var = cond
+        self.max_trip_count = max_trip_count
         self._steps_var: Optional[Variable] = None
 
     @contextlib.contextmanager
@@ -152,8 +162,18 @@ class While:
             stop_gradient=True,
         )
         self._steps_var = steps
+        attrs = {
+            "sub_block": sub,
+            "carry_names": carry_names,
+            "cond_name": cond_name,
+            "captured_names": captured,
+        }
+        op_type = "while"
+        if self.max_trip_count is not None:
+            op_type = "bounded_while"
+            attrs["max_trip_count"] = int(self.max_trip_count)
         parent.append_op(
-            "while",
+            op_type,
             inputs={
                 "Condition": [cond_name],
                 "X": carry_names,
@@ -164,12 +184,7 @@ class While:
                 "CondOut": [cond_name],
                 "Steps": [steps],
             },
-            attrs={
-                "sub_block": sub,
-                "carry_names": carry_names,
-                "cond_name": cond_name,
-                "captured_names": captured,
-            },
+            attrs=attrs,
         )
 
 

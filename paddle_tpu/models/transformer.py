@@ -90,7 +90,7 @@ def _positional_encoding(max_len: int, d_model: int) -> np.ndarray:
 
 
 def _multi_head_attention(q_in, kv_in, bias, cfg: TransformerConfig, prefix: str,
-                          is_test: bool):
+                          is_test: bool, causal: bool = False):
     h, dh, d = cfg.n_head, cfg.d_head, cfg.d_model
 
     # BTHD layout: [b, t, h, dh] straight off the projection reshape. The
@@ -129,6 +129,10 @@ def _multi_head_attention(q_in, kv_in, bias, cfg: TransformerConfig, prefix: str
             "dropout_prob": float(cfg.dropout),
             "is_test": is_test,
             "layout": "bthd",
+            # causal rides IN-KERNEL (position mask + dead-block skip in
+            # the flash kernels): no [t, t] bias tensor ever exists, the
+            # O(t) HBM property holds for decoder self-attention too
+            "causal": causal,
         },
     )
     ctx = layers.reshape(ctx, [0, 0, d])
@@ -213,7 +217,8 @@ def encoder_layer(x, bias, cfg, i, is_test):
 def decoder_layer(x, enc_out, self_bias, cross_bias, cfg, i, is_test):
     p = f"dec{i}"
     attn = _multi_head_attention(_ln(x, f"{p}_preself"), _ln(x, f"{p}_preself"),
-                                 self_bias, cfg, f"{p}_self", is_test)
+                                 self_bias, cfg, f"{p}_self", is_test,
+                                 causal=True)
     x = _pre_post(attn, x, cfg, p, is_test)
     ln_x = _ln(x, f"{p}_precross")
     cross = _multi_head_attention(ln_x, enc_out, cross_bias, cfg,
@@ -239,8 +244,10 @@ def _train_feeds_and_biases():
     helper.append_op("attn_bias", inputs={"PadMask": src_pad},
                      outputs={"Out": enc_bias}, attrs={"causal": False})
     dec_self_bias = helper.create_variable_for_type_inference("float32", True)
+    # pad-only [b, 1, 1, t]: the causal future-mask is applied in-kernel
+    # by the decoder self-attention (sdpa attr), never materialized
     helper.append_op("attn_bias", inputs={"PadMask": trg_pad},
-                     outputs={"Out": dec_self_bias}, attrs={"causal": True})
+                     outputs={"Out": dec_self_bias}, attrs={"causal": False})
     return src, trg, lbl, src_pad, trg_pad, enc_bias, dec_self_bias
 
 
@@ -412,7 +419,8 @@ def build_decode(cfg: Optional[TransformerConfig] = None, beam_size: int = 4,
             layers.cast(_op("fill_any_like", {"X": ids_flat}, {"value": 1.0},
                             dtype="int64"), "float32"),
             live)
-        self_bias = _op("attn_bias", {"PadMask": trg_pad}, {"causal": True})
+        self_bias = _op("attn_bias", {"PadMask": trg_pad},
+                        {"causal": False})  # causal is in-kernel (sdpa attr)
         dec = _embed(ids_flat, cfg.trg_vocab_size, cfg, "trg_emb.w",
                      "trg_pos.w", True)
         for i in range(cfg.n_layer):
@@ -546,7 +554,7 @@ def _w_ln(x, scale, bias):
     return y
 
 
-def _w_sdpa(q, k, v, bias, cfg, is_test):
+def _w_sdpa(q, k, v, bias, cfg, is_test, causal=False):
     from paddle_tpu.layer_helper import LayerHelper
 
     helper = LayerHelper("wsdpa")
@@ -565,12 +573,14 @@ def _w_sdpa(q, k, v, bias, cfg, is_test):
             "dropout_prob": float(cfg.dropout),
             "is_test": is_test,
             "layout": "bthd",
+            "causal": causal,
         },
     )
     return ctx
 
 
-def _w_attention(q_in, kv_in, bias, cfg, weights, is_test, fused_qkv):
+def _w_attention(q_in, kv_in, bias, cfg, weights, is_test, fused_qkv,
+                 causal=False):
     h, dh, d = cfg.n_head, cfg.d_head, cfg.d_model
 
     def split_heads(z):
@@ -584,7 +594,7 @@ def _w_attention(q_in, kv_in, bias, cfg, weights, is_test, fused_qkv):
         k = _w_fc(kv_in, weights["k.w"], weights["k.b"])
         v = _w_fc(kv_in, weights["v.w"], weights["v.b"])
     ctx = _w_sdpa(split_heads(q), split_heads(k), split_heads(v), bias,
-                  cfg, is_test)
+                  cfg, is_test, causal=causal)
     ctx = layers.reshape(ctx, [0, 0, d])
     return _w_fc(ctx, weights["out.w"], weights["out.b"])
 
@@ -783,7 +793,7 @@ def build_scan(cfg: Optional[TransformerConfig] = None,
              "k.w": w["self_k.w"], "k.b": w["self_k.b"],
              "v.w": w["self_v.w"], "v.b": w["self_v.b"],
              "out.w": w["self_out.w"], "out.b": w["self_out.b"]},
-            is_test, fused_qkv=False)
+            is_test, fused_qkv=False, causal=True)
         x = _w_drop_add(attn, x, cfg, is_test)
         ln_x = _w_ln(x, w["precross_ln.scale"], w["precross_ln.bias"])
         cross = _w_attention(

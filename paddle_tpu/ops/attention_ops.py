@@ -129,6 +129,7 @@ def _sdpa(ins, attrs, rng=None):
     bias = _x(ins, "Bias")
     scale, drop, seed, use_pallas = _sdpa_config(ins, attrs, rng)
     bthd = attrs.get("layout", "bhtd") == "bthd"
+    causal = bool(attrs.get("causal", False))
     from paddle_tpu.parallel import flash_attention as fa
 
     t_axis = 1 if bthd else 2
@@ -141,30 +142,36 @@ def _sdpa(ins, attrs, rng=None):
             out = ra.ring_attention(
                 jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                 jnp.swapaxes(v, 1, 2), mesh, seq_axis=ctx_axis,
-                scale=scale, bias=bias, data_axis=data_axis)
+                scale=scale, bias=bias, data_axis=data_axis,
+                causal=causal)
             out = jnp.swapaxes(out, 1, 2)
         else:
             out = ra.ring_attention(q, k, v, mesh, seq_axis=ctx_axis,
                                     scale=scale, bias=bias,
-                                    data_axis=data_axis)
+                                    data_axis=data_axis, causal=causal)
         lse = jnp.zeros(jnp.shape(q)[:3] + (1,), jnp.float32)
     elif bthd:
         if use_pallas:
             out, lse = fa.flash_attention_bthd_with_lse(
-                q, k, v, bias, seed, scale, float(drop))
+                q, k, v, bias, seed, scale, float(drop), causal)
         else:
             out = fa._reference_attention_bthd(
-                q, k, v, bias, scale, drop, seed if drop > 0.0 else None)
+                q, k, v,
+                fa._combined_causal_bias(bias, q.shape[1], k.shape[1])
+                if causal else bias,
+                scale, drop, seed if drop > 0.0 else None)
             lse = jnp.zeros(jnp.shape(q)[:3] + (1,), jnp.float32)
     elif use_pallas:
         # the custom-vjp wrapper makes the op differentiable through
         # jax.vjp too (scan-over-layers grad); the paired grad op below
         # remains the unrolled path's backward
         out, lse = fa.flash_attention_with_lse(q, k, v, bias, seed,
-                                               scale, float(drop))
+                                               scale, float(drop),
+                                               causal=causal)
     else:
         out = fa._reference_attention(q, k, v, bias, scale, drop,
-                                      seed if drop > 0.0 else None)
+                                      seed if drop > 0.0 else None,
+                                      causal=causal)
         lse = jnp.zeros(jnp.shape(q)[:3] + (1,), jnp.float32)
     return {"Out": [out.astype(q.dtype)], "Lse": [lse]}
 
@@ -181,6 +188,7 @@ def _sdpa_grad(ins, attrs, rng=None):
     g = _x(ins, "GRAD::Out")
     scale, drop, seed, use_pallas = _sdpa_config(ins, attrs, rng)
     bthd = attrs.get("layout", "bhtd") == "bthd"
+    causal = bool(attrs.get("causal", False))
     from paddle_tpu.parallel import flash_attention as fa
 
     t_axis = 1 if bthd else 2
@@ -194,11 +202,12 @@ def _sdpa_grad(ins, attrs, rng=None):
                 o = ra.ring_attention(
                     jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                     jnp.swapaxes(v, 1, 2), mesh, seq_axis=ctx_axis,
-                    scale=scale, bias=bias, data_axis=data_axis)
+                    scale=scale, bias=bias, data_axis=data_axis,
+                    causal=causal)
                 return jnp.swapaxes(o, 1, 2)
             return ra.ring_attention(
                 q, k, v, mesh, seq_axis=ctx_axis, scale=scale, bias=bias,
-                data_axis=data_axis,
+                data_axis=data_axis, causal=causal,
             )
 
         _, vjp = jax.vjp(f, q, k, v)
@@ -207,13 +216,15 @@ def _sdpa_grad(ins, attrs, rng=None):
         if use_pallas:
             dq, dk, dv = fa.flash_attention_bthd_bwd(
                 q, k, v, bias, seed, out, lse, g.astype(q.dtype),
-                scale=scale, p_drop=drop)
+                scale=scale, p_drop=drop, causal=causal)
         else:
             sd = seed if drop > 0.0 else None
+            eff_bias = fa._combined_causal_bias(
+                bias, q.shape[1], k.shape[1]) if causal else bias
 
             def f(q, k, v):
                 return fa._reference_attention_bthd(
-                    q, k, v, bias, scale, drop, sd).astype(q.dtype)
+                    q, k, v, eff_bias, scale, drop, sd).astype(q.dtype)
 
             _, vjp = jax.vjp(f, q, k, v)
             dq, dk, dv = vjp(g.astype(q.dtype))
@@ -223,13 +234,13 @@ def _sdpa_grad(ins, attrs, rng=None):
         # for masks and fallback conditions
         dq, dk, dv = fa.flash_attention_bwd(
             q, k, v, bias, seed, out, lse, g.astype(q.dtype),
-            scale=scale, p_drop=drop)
+            scale=scale, p_drop=drop, causal=causal)
     else:
         sd = seed if drop > 0.0 else None
 
         def f(q, k, v):
             return fa._reference_attention(q, k, v, bias, scale, drop,
-                                           sd).astype(q.dtype)
+                                           sd, causal=causal).astype(q.dtype)
 
         _, vjp = jax.vjp(f, q, k, v)
         dq, dk, dv = vjp(g.astype(q.dtype))

@@ -87,6 +87,56 @@ def _while(ins, attrs, rng=None):
     return {"Out": list(final[2:]), "CondOut": [final[1]], "Steps": [final[0]]}
 
 
+@register_op("bounded_while", diff_inputs=("X", "Captured"), needs_rng=True)
+def _bounded_while(ins, attrs, rng=None):
+    """Differentiable While: fixed trip count + liveness mask — the
+    trainable lowering of the reference's while_op grad
+    (operators/controlflow/while_op.cc:43 WhileGradOp). XLA's While is
+    not reverse-differentiable, so ``While(cond, max_trip_count=N)``
+    lowers to a ``lax.scan`` over exactly N steps where a dead step
+    passes its carries through a select — gradients flow through the
+    selects (dead iterations contribute zero) and through the captured
+    values (weights read inside the loop). Costs N body evaluations
+    regardless of the dynamic trip count; CondOut still True after N
+    steps means the loop was TRUNCATED (the bound is a hard contract).
+
+    Same attrs as ``while`` plus ``max_trip_count``; Steps counts the
+    live iterations.
+    """
+    sub = attrs["sub_block"]
+    carry_names = list(attrs["carry_names"])
+    cond_name = attrs["cond_name"]
+    cap_names = list(attrs.get("captured_names", []))
+    n_steps = int(attrs["max_trip_count"])
+    cap_vals = list(ins.get("Captured", []))
+    amp = interp.amp_active()
+    sub_ops = list(sub.ops)
+    init = tuple(ins.get("X", []))
+    init_dtypes = [jnp.result_type(v) for v in init]
+
+    def body(carry, i):
+        live, steps = carry[0], carry[1]
+        vals = carry[2:]
+        env = _sub_env(cap_names, cap_vals)
+        env[cond_name] = live
+        env.update(zip(carry_names, vals))
+        key = jax.random.fold_in(rng, i) if rng is not None else None
+        interp.exec_ops(sub_ops, env, key=key, amp=amp)
+        new_vals = tuple(
+            jnp.where(live, env[n].astype(dt), v)
+            for n, v, dt in zip(carry_names, vals, init_dtypes)
+        )
+        new_live = jnp.logical_and(live, _scalar_bool(env[cond_name]))
+        return ((new_live, steps + live.astype(jnp.int32)) + new_vals,
+                None)
+
+    carry0 = (_scalar_bool(ins["Condition"][0]),
+              jnp.zeros((), jnp.int32)) + init
+    final, _ = lax.scan(body, carry0, jnp.arange(n_steps, dtype=jnp.int32))
+    return {"Out": list(final[2:]), "CondOut": [final[0]],
+            "Steps": [final[1]]}
+
+
 @register_op("cond", diff_inputs=("Captured",), needs_rng=True)
 def _cond(ins, attrs, rng=None):
     """Select between two sub-blocks on a scalar predicate.

@@ -104,9 +104,29 @@ def _use_pallas(tq, tk, bq, bk):
 # ---------------------------------------------------------------------------
 
 
+def _causal_mask(s, j, kk, bq, bk, transposed=False):
+    """Mask future positions inside score block (h, bq, bk) for q-block
+    j / k-block kk (``transposed``: block is (h, bk, bq))."""
+    if transposed:
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + kk * bk
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2) + j * bq
+    else:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bq
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2) + kk * bk
+    return jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+
+def _causal_live(j, kk, bq, bk):
+    """Does block (q=j, k=kk) contain ANY unmasked element? Blocks fully
+    above the diagonal are skipped outright — the causal 2x compute cut
+    (loads still stream; compute and softmax are the bound)."""
+    return kk * bk <= (j + 1) * bq - 1
+
+
 def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, nk, p_drop):
-    kk = pl.program_id(2)
+                m_scr, l_scr, acc_scr, *, scale, nk, p_drop, causal=False):
+    j, kk = pl.program_id(1), pl.program_id(2)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
 
     @pl.when(kk == 0)
     def _init():
@@ -114,34 +134,42 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    if bias_ref is not None:
-        s = s + bias_ref[0].astype(jnp.float32)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            s = _causal_mask(s, j, kk, bq, bk)
 
-    m_prev = m_scr[:, :, :1]
-    l_prev = l_scr[:, :, :1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    corr = jnp.exp(m_prev - m_new)
-    l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        m_prev = m_scr[:, :, :1]
+        l_prev = l_scr[:, :, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
 
-    if p_drop > 0.0:
-        pltpu.prng_seed(
-            _block_seed(seed_ref[0], pl.program_id(0), pl.program_id(1), kk))
-        p = p * _dropout_mask(1.0 - p_drop, p.shape)
+        if p_drop > 0.0:
+            pltpu.prng_seed(
+                _block_seed(seed_ref[0], pl.program_id(0), j, kk))
+            p = p * _dropout_mask(1.0 - p_drop, p.shape)
 
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )
-    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        pl.when(_causal_live(j, kk, bq, bk))(_compute)
+    else:
+        _compute()
 
     @pl.when(kk == nk - 1)
     def _finish():
@@ -151,41 +179,51 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
 
 
 def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
-               delta_ref, dq_ref, dq_scr, *, scale, nk, p_drop):
-    kk = pl.program_id(2)
+               delta_ref, dq_ref, dq_scr, *, scale, nk, p_drop,
+               causal=False):
+    j, kk = pl.program_id(1), pl.program_id(2)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
 
     @pl.when(kk == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse = lse_ref[0]        # (h, bq, 1) f32
-    delta = delta_ref[0]    # (h, bq, 1) f32
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]        # (h, bq, 1) f32
+        delta = delta_ref[0]    # (h, bq, 1) f32
 
-    s = jax.lax.dot_general(
-        q, k, (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    if bias_ref is not None:
-        s = s + bias_ref[0].astype(jnp.float32)
-    p = jnp.exp(s - lse)  # post-softmax probabilities, recomputed
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        if causal:
+            s = _causal_mask(s, j, kk, bq, bk)
+        p = jnp.exp(s - lse)  # post-softmax probabilities, recomputed
 
-    dp = jax.lax.dot_general(
-        do, v, (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )
-    if p_drop > 0.0:
-        pltpu.prng_seed(
-            _block_seed(seed_ref[0], pl.program_id(0), pl.program_id(1), kk))
-        dp = dp * _dropout_mask(1.0 - p_drop, dp.shape)
-    ds = p * (dp - delta) * scale
-    dq_scr[:] += jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )
+        dp = jax.lax.dot_general(
+            do, v, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        if p_drop > 0.0:
+            pltpu.prng_seed(
+                _block_seed(seed_ref[0], pl.program_id(0), j, kk))
+            dp = dp * _dropout_mask(1.0 - p_drop, dp.shape)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(_causal_live(j, kk, bq, bk))(_compute)
+    else:
+        _compute()
 
     @pl.when(kk == nk - 1)
     def _finish():
@@ -194,59 +232,70 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
 
 def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
                 delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                scale, nq, p_drop):
-    jq = pl.program_id(2)
+                scale, nq, p_drop, causal=False):
+    kk, jq = pl.program_id(1), pl.program_id(2)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
 
     @pl.when(jq == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0]
-    lse_t = jnp.transpose(lse_ref[0], (0, 2, 1))      # (h, 1, bq)
-    delta_t = jnp.transpose(delta_ref[0], (0, 2, 1))  # (h, 1, bq)
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse_t = jnp.transpose(lse_ref[0], (0, 2, 1))      # (h, 1, bq)
+        delta_t = jnp.transpose(delta_ref[0], (0, 2, 1))  # (h, 1, bq)
 
-    # Work in the transposed orientation: s_t (h, bk, bq)
-    s_t = jax.lax.dot_general(
-        k, q, (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    if bias_ref is not None:
-        s_t = s_t + jnp.transpose(bias_ref[0].astype(jnp.float32), (0, 2, 1))
-    p_t = jnp.exp(s_t - lse_t)
+        # Work in the transposed orientation: s_t (h, bk, bq)
+        s_t = jax.lax.dot_general(
+            k, q, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if bias_ref is not None:
+            s_t = s_t + jnp.transpose(bias_ref[0].astype(jnp.float32),
+                                      (0, 2, 1))
+        if causal:
+            s_t = _causal_mask(s_t, jq, kk, bq, bk, transposed=True)
+        p_t = jnp.exp(s_t - lse_t)
 
-    if p_drop > 0.0:
-        # Same (b, q-block, k-block) stream as the forward, generated in the
-        # forward's (h, bq, bk) orientation then transposed.
-        pltpu.prng_seed(
-            _block_seed(seed_ref[0], pl.program_id(0), jq, pl.program_id(1)))
-        drop_t = jnp.transpose(
-            _dropout_mask(
-                1.0 - p_drop, (p_t.shape[0], p_t.shape[2], p_t.shape[1])),
-            (0, 2, 1),
+        if p_drop > 0.0:
+            # Same (b, q-block, k-block) stream as the forward, generated
+            # in the forward's (h, bq, bk) orientation then transposed.
+            pltpu.prng_seed(
+                _block_seed(seed_ref[0], pl.program_id(0), jq, kk))
+            drop_t = jnp.transpose(
+                _dropout_mask(
+                    1.0 - p_drop,
+                    (p_t.shape[0], p_t.shape[2], p_t.shape[1])),
+                (0, 2, 1),
+            )
+            pd_t = p_t * drop_t
+        else:
+            pd_t = p_t
+
+        dv_scr[:] += jax.lax.dot_general(
+            pd_t.astype(do.dtype), do, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
         )
-        pd_t = p_t * drop_t
-    else:
-        pd_t = p_t
+        dp_t = jax.lax.dot_general(
+            v, do, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        if p_drop > 0.0:
+            dp_t = dp_t * drop_t
+        ds_t = p_t * (dp_t - delta_t) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds_t.astype(q.dtype), q, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
 
-    dv_scr[:] += jax.lax.dot_general(
-        pd_t.astype(do.dtype), do, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )
-    dp_t = jax.lax.dot_general(
-        v, do, (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )
-    if p_drop > 0.0:
-        dp_t = dp_t * drop_t
-    ds_t = p_t * (dp_t - delta_t) * scale
-    dk_scr[:] += jax.lax.dot_general(
-        ds_t.astype(q.dtype), q, (((2,), (1,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )
+    if causal:
+        pl.when(_causal_live(jq, kk, bq, bk))(_compute)
+    else:
+        _compute()
 
     @pl.when(jq == nq - 1)
     def _finish():
@@ -273,11 +322,16 @@ def _bias_spec(bias, bq, bk, *, transposed=False):
     return pl.BlockSpec((1, hb, qdim, bk), idx)
 
 
-def _reference_attention(q, k, v, bias, scale, p_drop=0.0, seed=None):
+def _reference_attention(q, k, v, bias, scale, p_drop=0.0, seed=None,
+                         causal=False):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias.astype(s.dtype)
+    if causal:
+        tq, tk = q.shape[2], k.shape[2]
+        mask = (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])
+        s = jnp.where(mask[None, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     if p_drop > 0.0:
         key = jax.random.PRNGKey(0 if seed is None else jnp.asarray(seed))
@@ -309,9 +363,16 @@ def _seed_cotangent(seed):
 def flash_attention_fwd(q, k, v, bias=None, seed=None, scale=None,
                         p_drop: float = 0.0,
                         q_block: int = DEFAULT_Q_BLOCK,
-                        k_block: int = DEFAULT_K_BLOCK):
+                        k_block: int = DEFAULT_K_BLOCK,
+                        causal: bool = False):
     """-> (out, lse) with lse [b, h, tq, 1] f32 (zeros on the dense path,
-    which needs no saved stats: its backward recomputes via vjp)."""
+    which needs no saved stats: its backward recomputes via vjp).
+
+    ``causal=True`` applies the future mask IN-KERNEL (block-position
+    iota compare) and skips fully-masked k-blocks outright — no [tq, tk]
+    bias tensor exists anywhere, preserving the O(t) HBM property for
+    decoder self-attention, and the dead upper-triangle blocks cost no
+    MXU time (the causal ~2x)."""
     if p_drop > 0.0 and seed is None:
         raise ValueError(
             "flash_attention: p_drop > 0 requires a per-step `seed`; "
@@ -325,7 +386,8 @@ def flash_attention_fwd(q, k, v, bias=None, seed=None, scale=None,
     bq, bk = _pick_blocks(h, tq, tk, q_block, k_block)
     if not _use_pallas(tq, tk, bq, bk):
         out = _reference_attention(q, k, v, bias, scale, p_drop,
-                                   seed if p_drop > 0.0 else None)
+                                   seed if p_drop > 0.0 else None,
+                                   causal=causal)
         return out, jnp.zeros((b, h, tq, 1), jnp.float32)
 
     nq, nk = tq // bq, tk // bk
@@ -339,12 +401,12 @@ def flash_attention_fwd(q, k, v, bias=None, seed=None, scale=None,
         in_specs.append(_bias_spec(bias, bq, bk))
         args.append(bias)
         kernel = functools.partial(_fwd_kernel, scale=scale, nk=nk,
-                                   p_drop=p_drop)
+                                   p_drop=p_drop, causal=causal)
     else:
         kernel = functools.partial(
             lambda sr, qr, kr, vr, orf, lr, ms, ls, accs, **kw: _fwd_kernel(
                 sr, qr, kr, vr, None, orf, lr, ms, ls, accs, **kw),
-            scale=scale, nk=nk, p_drop=p_drop,
+            scale=scale, nk=nk, p_drop=p_drop, causal=causal,
         )
 
     out, lse = pl.pallas_call(
@@ -375,7 +437,8 @@ def flash_attention_fwd(q, k, v, bias=None, seed=None, scale=None,
 def flash_attention_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
                         p_drop: float = 0.0,
                         q_block: int = DEFAULT_Q_BLOCK,
-                        k_block: int = DEFAULT_K_BLOCK):
+                        k_block: int = DEFAULT_K_BLOCK,
+                        causal: bool = False):
     """-> (dq, dk, dv), consuming the forward's saved (out, lse)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -385,7 +448,8 @@ def flash_attention_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
     if not _use_pallas(tq, tk, bq, bk):
         def f(q, k, v):
             return _reference_attention(q, k, v, bias, scale, p_drop,
-                                        seed if p_drop > 0.0 else None)
+                                        seed if p_drop > 0.0 else None,
+                                        causal=causal)
 
         _, vjp = jax.vjp(f, q, k, v)
         return vjp(g)
@@ -406,12 +470,12 @@ def flash_attention_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
         dq_specs.append(_bias_spec(bias, bq, bk))
         dq_args.append(bias)
         dq_kernel = functools.partial(_dq_kernel, scale=scale, nk=nk,
-                                      p_drop=p_drop)
+                                      p_drop=p_drop, causal=causal)
     else:
         dq_kernel = functools.partial(
             lambda sr, qr, kr, vr, dor, lr, der, dqr, dqs, **kw: _dq_kernel(
                 sr, qr, kr, vr, None, dor, lr, der, dqr, dqs, **kw),
-            scale=scale, nk=nk, p_drop=p_drop,
+            scale=scale, nk=nk, p_drop=p_drop, causal=causal,
         )
     dq_specs += [
         pl.BlockSpec((1, h, bq, dh), lambda i, j, kk, *_: (i, 0, j, 0)),  # do
@@ -445,13 +509,13 @@ def flash_attention_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
         dkv_specs.append(_bias_spec(bias, bq, bk, transposed=True))
         dkv_args.append(bias)
         dkv_kernel = functools.partial(_dkv_kernel, scale=scale, nq=nq,
-                                       p_drop=p_drop)
+                                       p_drop=p_drop, causal=causal)
     else:
         dkv_kernel = functools.partial(
             lambda sr, qr, kr, vr, dor, lr, der, dkr, dvr, dks, dvs, **kw:
                 _dkv_kernel(sr, qr, kr, vr, None, dor, lr, der, dkr, dvr,
                             dks, dvs, **kw),
-            scale=scale, nq=nq, p_drop=p_drop,
+            scale=scale, nq=nq, p_drop=p_drop, causal=causal,
         )
     dkv_specs += [
         pl.BlockSpec((1, h, bq, dh), lambda i, kk, j, *_: (i, 0, j, 0)),  # do
@@ -492,28 +556,30 @@ def flash_attention_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def flash_attention(q, k, v, bias=None, seed=None,
                     scale: Optional[float] = None, p_drop: float = 0.0,
                     q_block: int = DEFAULT_Q_BLOCK,
-                    k_block: int = DEFAULT_K_BLOCK):
+                    k_block: int = DEFAULT_K_BLOCK,
+                    causal: bool = False):
     """o = dropout(softmax(q k^T * scale + bias)) v.
 
     ``seed``: int32 scalar array driving attention dropout (ignored when
     p_drop == 0). See the module docstring for the bias-gradient caveat.
     """
     out, _ = flash_attention_fwd(q, k, v, bias, seed, scale, p_drop,
-                                 q_block, k_block)
+                                 q_block, k_block, causal)
     return out
 
 
-def _vjp_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block):
+def _vjp_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block,
+             causal=False):
     out, lse = flash_attention_fwd(q, k, v, bias, seed, scale, p_drop,
-                                   q_block, k_block)
+                                   q_block, k_block, causal)
     return out, (q, k, v, bias, seed, out, lse)
 
 
-def _vjp_bwd(scale, p_drop, q_block, k_block, res, g):
+def _vjp_bwd(scale, p_drop, q_block, k_block, causal, res, g):
     q, k, v, bias, seed, out, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -521,7 +587,8 @@ def _vjp_bwd(scale, p_drop, q_block, k_block, res, g):
                           q_block, k_block)
     if _use_pallas(q.shape[2], k.shape[2], bq, bk):
         dq, dk, dv = flash_attention_bwd(q, k, v, bias, seed, out, lse, g,
-                                         scale, p_drop, q_block, k_block)
+                                         scale, p_drop, q_block, k_block,
+                                         causal)
         # Pallas path: bias is mask plumbing, cotangent intentionally zero
         # (see module docstring).
         dbias = None if bias is None else jnp.zeros_like(bias)
@@ -530,13 +597,13 @@ def _vjp_bwd(scale, p_drop, q_block, k_block, res, g):
         if bias is None:
             _, vjp = jax.vjp(
                 lambda a, b, c: _reference_attention(
-                    a, b, c, None, scale, p_drop, sd), q, k, v)
+                    a, b, c, None, scale, p_drop, sd, causal), q, k, v)
             dq, dk, dv = vjp(g)
             dbias = None
         else:
             _, vjp = jax.vjp(
                 lambda a, b, c, bb: _reference_attention(
-                    a, b, c, bb, scale, p_drop, sd), q, k, v, bias)
+                    a, b, c, bb, scale, p_drop, sd, causal), q, k, v, bias)
             dq, dk, dv, dbias = vjp(g)
     return dq, dk, dv, dbias, _seed_cotangent(seed)
 
@@ -554,31 +621,33 @@ flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
 # path, sharing the same kernels.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def flash_attention_with_lse(q, k, v, bias=None, seed=None,
                              scale: Optional[float] = None,
                              p_drop: float = 0.0,
                              q_block: int = DEFAULT_Q_BLOCK,
-                             k_block: int = DEFAULT_K_BLOCK):
+                             k_block: int = DEFAULT_K_BLOCK,
+                             causal: bool = False):
     """(out, lse) variant of ``flash_attention`` — same backward rule
     (shared ``_vjp_bwd``: blocked Pallas kernels, true dbias on the dense
     fallback, float0 seed cotangent). The sdpa op uses this so its saved
     Lse output exists AND jax.vjp through the op (scan-over-layers grad)
     works despite pallas_call having no JVP rule."""
     return flash_attention_fwd(q, k, v, bias, seed, scale, p_drop,
-                               q_block, k_block)
+                               q_block, k_block, causal)
 
 
-def _fa_lse_vjp_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block):
+def _fa_lse_vjp_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block,
+                    causal=False):
     out, lse = flash_attention_fwd(q, k, v, bias, seed, scale, p_drop,
-                                   q_block, k_block)
+                                   q_block, k_block, causal)
     return (out, lse), (q, k, v, bias, seed, out, lse)
 
 
-def _fa_lse_vjp_bwd(scale, p_drop, q_block, k_block, res, gs):
+def _fa_lse_vjp_bwd(scale, p_drop, q_block, k_block, causal, res, gs):
     g, _g_lse = gs  # lse is a saved statistic, not a training signal
     q = res[0]
-    return _vjp_bwd(scale, p_drop, q_block, k_block, res,
+    return _vjp_bwd(scale, p_drop, q_block, k_block, causal, res,
                     g.astype(q.dtype))
 
 
@@ -1078,10 +1147,30 @@ def _bthd_kb_bwd(q, k, v, bias, seed, out, lse, g, scale, p_drop):
             jax.ShapeDtypeStruct((b, tk, hdh), k.dtype),
             jax.ShapeDtypeStruct((b, tk, hdh), v.dtype),
         ],
+        # The fused kb backward's phase temps land at ~16.7M of Mosaic
+        # scoped-vmem stack when compiled inside a run_steps While body
+        # on the current toolchain (16.0M default limit; it fits
+        # standalone). 24M is still a small fraction of the v5e's 128M
+        # VMEM and keeps cq=128 (halving cq would double the dq-scratch
+        # RMW passes on the t=1024 headline config).
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=24 * 1024 * 1024),
         interpret=_INTERPRET,
     )(_seed_arr(seed), *base_args, *tail_args)
     return (dq2.reshape(b, tq, h, dh), dk2.reshape(b, tk, h, dh),
             dv2.reshape(b, tk, h, dh))
+
+
+def _combined_causal_bias(bias, tq, tk):
+    """Fold the causal future-mask into an additive bias for the BTHD
+    small/k-blocked kernels (t <= 1024 there, so the [tq, tk] tensor is
+    bounded at ~4MB and XLA CSEs the pure computation across layers).
+    The long-context BHTD kernels never take this path — they get the
+    in-kernel position mask instead."""
+    tri = jnp.where(
+        jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :],
+        jnp.float32(0), jnp.float32(_NEG_INF))[None, None]
+    return tri if bias is None else bias.astype(jnp.float32) + tri
 
 
 def _reference_attention_bthd(q, k, v, bias, scale, p_drop=0.0, seed=None):
@@ -1098,9 +1187,12 @@ def _reference_attention_bthd(q, k, v, bias, scale, p_drop=0.0, seed=None):
 
 
 def flash_attention_bthd_fwd(q, k, v, bias=None, seed=None, scale=None,
-                             p_drop: float = 0.0):
+                             p_drop: float = 0.0, causal: bool = False):
     """q [b, tq, h, dh], k/v [b, tk, h, dh] -> (out [b, tq, h, dh],
-    lse [b, tq, h, 1] f32; zeros on the dense fallback)."""
+    lse [b, tq, h, 1] f32; zeros on the dense fallback). ``causal``:
+    in-kernel future mask on the long-context BHTD path (no [tq, tk]
+    tensor, dead blocks skipped); folded into a bounded bias on the
+    t <= 1024 small/k-blocked paths."""
     if p_drop > 0.0 and seed is None:
         raise ValueError("flash_attention: p_drop > 0 requires `seed`")
     b, tq, h, dh = q.shape
@@ -1109,17 +1201,25 @@ def flash_attention_bthd_fwd(q, k, v, bias=None, seed=None, scale=None,
         scale = 1.0 / math.sqrt(dh)
     if not _use_bthd_small(tq, tk):
         if _use_bthd_kblock(tq, tk, h, dh):
+            if causal:
+                bias = _combined_causal_bias(bias, tq, tk)
             return _bthd_kb_fwd(q, k, v, bias, seed, scale, p_drop)
         if (jax.default_backend() == "tpu" or _INTERPRET) and tk > _SMALL_T_MAX:
             # very long context: one transpose pair into the head-batched
-            # K-blocked kernels (dk/dv won't fit VMEM scratch as one piece)
+            # K-blocked kernels (dk/dv won't fit VMEM scratch as one
+            # piece); causal rides the in-kernel mask + block skip
             out, lse = flash_attention_fwd(
                 jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-                jnp.swapaxes(v, 1, 2), bias, seed, scale, p_drop)
+                jnp.swapaxes(v, 1, 2), bias, seed, scale, p_drop,
+                causal=causal)
             return jnp.swapaxes(out, 1, 2), jnp.swapaxes(lse, 1, 2)
+        if causal:
+            bias = _combined_causal_bias(bias, tq, tk)
         out = _reference_attention_bthd(q, k, v, bias, scale, p_drop,
                                         seed if p_drop > 0.0 else None)
         return out, jnp.zeros((b, tq, h, 1), jnp.float32)
+    if causal:
+        bias = _combined_causal_bias(bias, tq, tk)
 
     cq = _pick_cq(tq, tk, h)
     nq = tq // cq
@@ -1164,15 +1264,18 @@ def flash_attention_bthd_fwd(q, k, v, bias=None, seed=None, scale=None,
 
 
 def flash_attention_bthd_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
-                             p_drop: float = 0.0):
+                             p_drop: float = 0.0, causal: bool = False):
     """-> (dq, dk, dv) in [b, t, h, dh], consuming the forward's saved
-    (out, lse)."""
+    (out, lse). ``causal`` routes exactly as the forward did, so the
+    recomputed p matches block for block."""
     b, tq, h, dh = q.shape
     tk = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(dh)
     if not _use_bthd_small(tq, tk):
         if _use_bthd_kblock(tq, tk, h, dh):
+            if causal:
+                bias = _combined_causal_bias(bias, tq, tk)
             return _bthd_kb_bwd(q, k, v, bias, seed, out, lse, g, scale,
                                 p_drop)
         if (jax.default_backend() == "tpu" or _INTERPRET) and tk > _SMALL_T_MAX:
@@ -1180,9 +1283,11 @@ def flash_attention_bthd_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
                 jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                 jnp.swapaxes(v, 1, 2), bias, seed,
                 jnp.swapaxes(out, 1, 2), jnp.swapaxes(lse, 1, 2),
-                jnp.swapaxes(g, 1, 2), scale, p_drop)
+                jnp.swapaxes(g, 1, 2), scale, p_drop, causal=causal)
             return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
                     jnp.swapaxes(dv, 1, 2))
+        if causal:
+            bias = _combined_causal_bias(bias, tq, tk)
 
         def f(q, k, v):
             return _reference_attention_bthd(
@@ -1191,6 +1296,8 @@ def flash_attention_bthd_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
 
         _, vjp = jax.vjp(f, q, k, v)
         return vjp(g)
+    if causal:
+        bias = _combined_causal_bias(bias, tq, tk)
 
     # The fused kernel keeps four (cq, tk) f32 temps per head live; halve
     # the chunk relative to the forward so the per-head phase temps fit
@@ -1256,10 +1363,11 @@ def flash_attention_bthd_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
             dv2.reshape(b, tk, h, dh))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
 def flash_attention_bthd_with_lse(q, k, v, bias=None, seed=None,
                                   scale: Optional[float] = None,
-                                  p_drop: float = 0.0):
+                                  p_drop: float = 0.0,
+                                  causal: bool = False):
     """(out, lse) in BTHD with a custom vjp over the single-block kernels
     (pallas_call has no JVP rule); the paired sdpa grad op uses the _bwd
     entry directly with the saved stats.
@@ -1268,35 +1376,47 @@ def flash_attention_bthd_with_lse(q, k, v, bias=None, seed=None,
     its cotangent is ZEROS (a true dbias would materialize a tq x tk
     gradient per head). Pass a learnable additive bias only through the
     dense composition (small shapes), which computes the real dbias."""
-    return flash_attention_bthd_fwd(q, k, v, bias, seed, scale, p_drop)
+    return flash_attention_bthd_fwd(q, k, v, bias, seed, scale, p_drop,
+                                    causal)
 
 
-def _bthd_vjp_fwd(q, k, v, bias, seed, scale, p_drop):
-    out, lse = flash_attention_bthd_fwd(q, k, v, bias, seed, scale, p_drop)
+def _bthd_vjp_fwd(q, k, v, bias, seed, scale, p_drop, causal=False):
+    out, lse = flash_attention_bthd_fwd(q, k, v, bias, seed, scale, p_drop,
+                                        causal)
     return (out, lse), (q, k, v, bias, seed, out, lse)
 
 
-def _bthd_vjp_bwd(scale, p_drop, res, gs):
+def _bthd_vjp_bwd(scale, p_drop, causal, res, gs):
     g, _g_lse = gs
     q, k, v, bias, seed, out, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if _use_bthd_small(q.shape[1], k.shape[1]) or k.shape[1] > _SMALL_T_MAX:
         dq, dk, dv = flash_attention_bthd_bwd(
-            q, k, v, bias, seed, out, lse, g.astype(q.dtype), scale, p_drop)
+            q, k, v, bias, seed, out, lse, g.astype(q.dtype), scale, p_drop,
+            causal)
         dbias = None if bias is None else jnp.zeros_like(bias)
     else:
         sd = seed if p_drop > 0.0 else None
+        tq_, tk_ = q.shape[1], k.shape[1]
         if bias is None:
+            # the causal fold is a constant here — fold it outside vjp
+            eff_bias = (_combined_causal_bias(None, tq_, tk_)
+                        if causal else None)
             _, vjp = jax.vjp(
                 lambda a, b, c: _reference_attention_bthd(
-                    a, b, c, None, scale, p_drop, sd), q, k, v)
+                    a, b, c, eff_bias, scale, p_drop, sd), q, k, v)
             dq, dk, dv = vjp(g.astype(q.dtype))
             dbias = None
         else:
+            # bias is differentiated: the fold must happen INSIDE the
+            # vjp'd function so dbias reflects only the caller's bias
             _, vjp = jax.vjp(
                 lambda a, b, c, bb_: _reference_attention_bthd(
-                    a, b, c, bb_, scale, p_drop, sd), q, k, v, bias)
+                    a, b, c,
+                    _combined_causal_bias(bb_, tq_, tk_) if causal
+                    else bb_,
+                    scale, p_drop, sd), q, k, v, bias)
             dq, dk, dv, dbias = vjp(g.astype(q.dtype))
     return dq, dk, dv, dbias, _seed_cotangent(seed)
 

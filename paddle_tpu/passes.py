@@ -182,3 +182,201 @@ def _fc_fuse(program, scope=None, fetch_targets=(), **kw):
         ]
         program._bump_version()
     return program
+
+
+# Op types safe to deduplicate / fold: deterministic pure functions of
+# their inputs+attrs (no PRNG, no state updates, no side effects, no
+# sub-blocks). Conservative by construction — unlisted types are left
+# alone. Reference analogs: framework/ir/ (constant folding) and the
+# executor-level CSE the reference gets from its SSA graph.
+_PURE_OP_TYPES = frozenset({
+    "scale", "cast", "reshape", "transpose", "unsqueeze", "squeeze",
+    "expand", "slice", "concat", "stack", "split",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow",
+    "relu", "sigmoid", "tanh", "exp", "log", "sqrt", "square", "abs",
+    "softmax", "log_softmax",
+    "matmul", "mul",
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "mean",
+    "fill_constant", "fill_any_like", "assign_value", "range",
+    "less_than", "less_equal", "greater_than", "greater_equal", "equal",
+    "not_equal", "logical_and", "logical_or", "logical_not",
+    "attn_bias", "one_hot", "lookup_table",
+})
+
+# Pure generators with NO inputs: their (type, attrs) alone determines
+# the value, so they both seed constant folding and are CSE-able.
+_CONST_GENERATORS = frozenset({"fill_constant", "assign_value", "range"})
+
+
+def _unstable_vars(block):
+    """Var names whose value is NOT a pure function of their name within
+    the block — reassigned names (multiple writers: assign output=,
+    increment in_place, a while op's Out carries) or names read before
+    their (only) writer (a feed/outer var later overwritten). Name-keyed
+    optimizations (CSE, constant folding) must not treat reads of these
+    as referentially transparent: the same name denotes different values
+    at different program points."""
+    first_write = {}
+    writers = {}
+    first_read = {}
+    for idx, op in enumerate(block.ops):
+        for n in op.input_arg_names:
+            first_read.setdefault(n, idx)
+        for n in op.output_arg_names:
+            writers[n] = writers.get(n, 0) + 1
+            first_write.setdefault(n, idx)
+    unstable = {n for n, c in writers.items() if c > 1}
+    for n, w in first_write.items():
+        if first_read.get(n, w + 1) < w:
+            unstable.add(n)  # read-before-write: the name is reused
+    return unstable
+
+
+def _op_key(op):
+    """Hashable identity of a pure op: (type, sorted inputs, sorted
+    attrs). None when any attr resists cheap stable serialization."""
+    try:
+        attrs = tuple(sorted((k, repr(v)) for k, v in op.attrs.items()))
+    except Exception:
+        return None
+    ins = tuple(sorted((slot, tuple(ns)) for slot, ns in op.inputs.items()))
+    return (op.type, ins, attrs)
+
+
+@register_pass("cse")
+def _cse(program, scope=None, fetch_targets=(), **kw):
+    """Common-subexpression elimination over the global block: two pure
+    ops with identical (type, inputs, attrs) compute the same value, so
+    the later one's outputs alias the earlier one's (consumers are
+    renamed; the duplicate op is dropped). Whole-program XLA lowering
+    gets this from XLA itself; this pass exists for SERIALIZED programs
+    — inference artifacts and the sub-block interp path — where
+    duplicate chains (e.g. per-layer rebuilt attention biases) would
+    otherwise execute N times. Persistable or fetched outputs are never
+    aliased away."""
+    block = program.global_block()
+    fetch_names = {f if isinstance(f, str) else f.name
+                   for f in fetch_targets}
+    unstable = _unstable_vars(block)
+    seen = {}           # op key -> canonical op index
+    rename = {}         # var name -> canonical var name
+    drop = set()
+    for idx, op in enumerate(block.ops):
+        # apply pending renames so chained duplicates collapse
+        # transitively in one pass
+        if any(n in rename for ns in op.inputs.values() for n in ns):
+            op.inputs = {
+                slot: [rename.get(n, n) for n in ns]
+                for slot, ns in op.inputs.items()
+            }
+        if op.type not in _PURE_OP_TYPES:
+            continue
+        # reads or writes of a reassigned name are position-dependent:
+        # two textually identical ops can observe different values
+        if any(n in unstable
+               for ns in list(op.inputs.values()) + list(op.outputs.values())
+               for n in ns):
+            continue
+        key = _op_key(op)
+        if key is None:
+            continue
+        canon = seen.get(key)
+        if canon is None:
+            seen[key] = idx
+            continue
+        outs = [n for ns in op.outputs.values() for n in ns]
+        if any(graph_is_persistable(block, n) or n in fetch_names
+               for n in outs):
+            continue
+        canon_op = block.ops[canon]
+        for slot, ns in op.outputs.items():
+            for a, b in zip(ns, canon_op.outputs.get(slot, [])):
+                rename[a] = b
+        drop.add(idx)
+    if drop:
+        for idx in drop:
+            for ns in block.ops[idx].outputs.values():
+                for n in ns:
+                    block.vars.pop(n, None)
+        block.ops[:] = [op for idx, op in enumerate(block.ops)
+                        if idx not in drop]
+        program._bump_version()
+    return program
+
+
+def graph_is_persistable(block, name):
+    v = block._find_var_recursive(name)
+    return bool(v is not None and getattr(v, "persistable", False))
+
+
+@register_pass("constant_fold")
+def _constant_fold(program, scope=None, fetch_targets=(),
+                   max_elems=4096, **kw):
+    """Fold pure ops whose inputs are all compile-time constants
+    (transitively rooted at fill_constant / assign_value / range) into
+    ``assign_value`` literals, evaluated through the op kernels
+    themselves (one source of truth for semantics; reference analog:
+    the constant-folding IR pass). Results larger than ``max_elems``
+    stay unfolded — giant literals would bloat the serialized program
+    past what the fold saves."""
+    import numpy as np
+
+    from paddle_tpu.core import interp as _interp
+    from paddle_tpu.framework import Operator
+
+    block = program.global_block()
+    fetch_names = {f if isinstance(f, str) else f.name
+                   for f in fetch_targets}
+    unstable = _unstable_vars(block)
+    const_vals = {}     # var name -> np.ndarray
+    replace = {}        # op idx -> Operator (assign_value) or None=drop
+    for idx, op in enumerate(block.ops):
+        if op.type not in _PURE_OP_TYPES:
+            continue
+        # a reassigned name is not a constant even when its first writer
+        # is one (assign output= / increment / while carries rebind it)
+        if any(n in unstable
+               for ns in list(op.inputs.values()) + list(op.outputs.values())
+               for n in ns):
+            continue
+        ins = [n for ns in op.inputs.values() for n in ns if n]
+        if op.type not in _CONST_GENERATORS and (
+                not ins or not all(n in const_vals for n in ins)):
+            continue
+        if op.type in _CONST_GENERATORS and ins:
+            if not all(n in const_vals for n in ins):
+                continue
+        key = _op_key(op)
+        if key is None:
+            continue
+        try:
+            env = {n: const_vals[n] for n in ins}
+            _interp.exec_ops([op], env, key=None, amp=False)
+        except Exception:
+            continue
+        outs = [n for ns in op.outputs.values() for n in ns]
+        vals = {n: np.asarray(env[n]) for n in outs}
+        if any(v.size > max_elems for v in vals.values()):
+            continue
+        const_vals.update(vals)
+        if op.type in _CONST_GENERATORS and len(outs) == 1:
+            # already a literal; no rewrite needed, but it seeds folds
+            continue
+        if len(outs) == 1 and outs[0] not in fetch_names \
+                and not graph_is_persistable(block, outs[0]):
+            v = vals[outs[0]]
+            replace[idx] = Operator(
+                block, "assign_value", inputs={},
+                outputs={"Out": [outs[0]]},
+                attrs={"shape": list(v.shape),
+                       "dtype": str(v.dtype),
+                       "values": v.reshape(-1).tolist()})
+    if replace:
+        # ops whose outputs became dead literals' inputs are cleaned by
+        # a follow-up inference_prune; here only the folds are applied
+        block.ops[:] = [replace.get(idx, op)
+                        for idx, op in enumerate(block.ops)]
+        program._bump_version()
+    return program

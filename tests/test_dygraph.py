@@ -354,6 +354,7 @@ def test_gru_unit_without_bias():
         assert h.shape == (2, 8)
 
 
+@pytest.mark.full
 def test_dygraph_round4_layer_classes():
     """The 8 reference dygraph classes added round 4 (Conv3D,
     Conv3DTranspose, NCE, BilinearTensorProduct, SequenceConv, RowConv,

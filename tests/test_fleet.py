@@ -49,7 +49,8 @@ def _single_process_losses():
     return out
 
 
-@pytest.mark.parametrize("n_workers", [2, 4])
+@pytest.mark.parametrize("n_workers", [
+    2, pytest.param(4, marks=pytest.mark.full)])
 def test_fleet_multi_process_loss_parity(n_workers):
     from paddle_tpu import native
 

@@ -73,6 +73,7 @@ def test_moe_capacity_overflow_identity_path():
     assert changed.sum() == 2, changed.sum()
 
 
+@pytest.mark.full
 def test_moe_gradients_flow():
     """Every expert leaf AND the router get nonzero finite grads
     (round-4 fold reversed: its own test again for failure isolation;

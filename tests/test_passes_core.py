@@ -1,0 +1,128 @@
+"""CSE + constant-fold program passes (reference analogs:
+framework/ir constant folding and the SSA-graph-level dedup; ours run
+at Program altitude for serialized/inference programs — whole-program
+XLA gets both from the compiler)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.passes import apply_pass
+
+
+def _count(prog, t):
+    return sum(1 for op in prog.global_block().ops if op.type == t)
+
+
+def test_cse_collapses_duplicate_chains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        # two identical pure chains (the per-layer rebuilt-bias shape)
+        a = layers.scale(layers.relu(x), scale=2.0)
+        b = layers.scale(layers.relu(x), scale=2.0)
+        c = layers.scale(layers.relu(x), scale=3.0)  # differs: kept
+        out = layers.elementwise_add(layers.elementwise_add(a, b), c)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fd = {"x": np.random.RandomState(0).randn(4, 8).astype(np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (ref,) = exe.run(main, feed=fd, fetch_list=[out])
+
+        assert _count(main, "relu") == 3 and _count(main, "scale") == 3
+        apply_pass("cse", main, fetch_targets=[out])
+        # all three relu(x) collapse to one; the 2.0-scales collapse,
+        # the 3.0-scale stays distinct
+        assert _count(main, "relu") == 1
+        assert _count(main, "scale") == 2
+        (got,) = exe.run(main, feed=fd, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref))
+
+
+def test_cse_never_touches_stateful_or_random():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        d1 = layers.dropout(x, 0.5)
+        d2 = layers.dropout(x, 0.5)  # SAME attrs but independent masks
+        out = layers.elementwise_add(d1, d2)
+    n = _count(main, "dropout")
+    apply_pass("cse", main, fetch_targets=[out])
+    assert _count(main, "dropout") == n  # not deduplicated
+
+
+def test_constant_fold_evaluates_pure_subgraph():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        c1 = layers.fill_constant([4], "float32", 2.0)
+        c2 = layers.scale(c1, scale=3.0)           # foldable -> 6.0
+        c3 = layers.elementwise_add(c1, c2)        # foldable -> 8.0
+        out = layers.elementwise_add(x, c3)        # depends on feed: kept
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fd = {"x": np.ones((2, 4), np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (ref,) = exe.run(main, feed=fd, fetch_list=[out])
+        apply_pass("constant_fold", main, fetch_targets=[out])
+        types = [op.type for op in main.global_block().ops]
+        assert "scale" not in types          # folded to a literal
+        # the constant add folded to a literal; only the feed-dependent
+        # add survives
+        assert types.count("elementwise_add") == 1
+        folded = [op for op in main.global_block().ops
+                  if op.type == "assign_value"
+                  and op.outputs["Out"][0] == c3.name]
+        assert folded and folded[0].attrs["values"] == [8.0] * 4
+        (got,) = exe.run(main, feed=fd, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref))
+
+
+def test_cse_respects_var_reassignment():
+    """A name rewritten between two textually identical ops (assign
+    output=) denotes DIFFERENT values — CSE must not alias them."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        a = layers.scale(x, scale=2.0)
+        layers.assign(layers.scale(x, scale=0.0), output=x)
+        b = layers.scale(x, scale=2.0)   # reads the ZEROED x
+        out = layers.elementwise_add(a, b)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fd = {"x": np.ones((2, 4), np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (ref,) = exe.run(main, feed=fd, fetch_list=[out])
+        apply_pass("cse", main, fetch_targets=[out])
+        (got,) = exe.run(main, feed=fd, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref))
+    assert float(np.asarray(ref)[0, 0]) == 2.0  # a=2, b=0
+
+
+def test_constant_fold_respects_var_reassignment():
+    """A constant-seeded var mutated at runtime (assign output=) is not
+    a constant; folding its readers would bake the stale value."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        c = layers.fill_constant([4], "float32", 1.0)
+        layers.assign(x, output=c)       # c now holds the feed
+        y = layers.scale(c, scale=3.0)
+        out = layers.elementwise_add(x, y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fd = {"x": np.full((2, 4), 2.0, np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (ref,) = exe.run(main, feed=fd, fetch_list=[out])
+        apply_pass("constant_fold", main, fetch_targets=[out])
+        types = [op.type for op in main.global_block().ops]
+        assert "scale" in types          # NOT folded: c is reassigned
+        (got,) = exe.run(main, feed=fd, fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref))
+    assert float(np.asarray(ref)[0, 0]) == 8.0  # 2 + 3*2

@@ -71,6 +71,7 @@ def test_dp_tp_loss_parity():
     np.testing.assert_allclose(single, sharded, rtol=0, atol=2e-4)
 
 
+@pytest.mark.full
 def test_tp_param_is_actually_sharded():
     """The column-parallel weight must be laid out sharded on the mesh, not
     replicated — guards against rules silently degrading to replication."""
