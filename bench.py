@@ -158,7 +158,7 @@ def main():
             log(f"rider bench failed: {type(e).__name__}: {e}")
             return None
 
-    resnet = longctx = None
+    resnet = None
     families = {}
     here = os.path.dirname(os.path.abspath(__file__))
     want_resnet = os.environ.get("PT_BENCH_RESNET", "1") == "1"
@@ -173,33 +173,23 @@ def main():
         resnet = _rider(
             [sys.executable, os.path.join(here, "bench_resnet.py")], {})
         log(f"resnet50: {resnet}")
-    longctx4k = longctx8k = None
+    longctx_rows = {}
     if want_longctx:
-        longctx = _rider(
-            [sys.executable, os.path.join(here, "bench.py")],
-            {"PT_BENCH_BATCH": "8", "PT_BENCH_SEQ": "1024",
-             "PT_BENCH_FAMILIES": "0"})
-        if longctx is not None:
-            longctx["metric"] = "transformer_longctx_t1024_tokens_per_sec"
-        log(f"long-context t=1024: {longctx}")
-        # ACTUAL long context (VERDICT r4 item 2): t=4096 and t=8192 at
-        # constant total tokens/step, riding the in-kernel-causal flash
-        # path (no [t, t] tensor anywhere; decoder-self dead blocks
-        # skipped)
-        longctx4k = _rider(
-            [sys.executable, os.path.join(here, "bench.py")],
-            {"PT_BENCH_BATCH": "2", "PT_BENCH_SEQ": "4096",
-             "PT_BENCH_FAMILIES": "0"})
-        if longctx4k is not None:
-            longctx4k["metric"] = "transformer_longctx_t4096_tokens_per_sec"
-        log(f"long-context t=4096: {longctx4k}")
-        longctx8k = _rider(
-            [sys.executable, os.path.join(here, "bench.py")],
-            {"PT_BENCH_BATCH": "1", "PT_BENCH_SEQ": "8192",
-             "PT_BENCH_FAMILIES": "0"})
-        if longctx8k is not None:
-            longctx8k["metric"] = "transformer_longctx_t8192_tokens_per_sec"
-        log(f"long-context t=8192: {longctx8k}")
+        # long-context sweep at constant total tokens/step; t>=4096 rides
+        # the in-kernel-causal flash path (no [t, t] tensor anywhere;
+        # decoder-self dead blocks skipped) — VERDICT r4 item 2
+        for t, bt in (("1024", "8"), ("4096", "2"), ("8192", "1")):
+            row = _rider(
+                [sys.executable, os.path.join(here, "bench.py")],
+                {"PT_BENCH_BATCH": bt, "PT_BENCH_SEQ": t,
+                 "PT_BENCH_FAMILIES": "0"})
+            if row is not None:
+                row["metric"] = f"transformer_longctx_t{t}_tokens_per_sec"
+            longctx_rows[t] = row
+            log(f"long-context t={t}: {row}")
+    longctx = longctx_rows.get("1024")
+    longctx4k = longctx_rows.get("4096")
+    longctx8k = longctx_rows.get("8192")
     if want_families:
         # remaining BASELINE.md rows, one fresh process per family
         for fam, env in (

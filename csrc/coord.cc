@@ -154,6 +154,14 @@ void handle_conn(Server* srv, int fd) {
       }
       l.unlock();
       if (!send_msg(fd, "OK")) break;
+    } else if (op == 'D') {
+      // delete a KV key (KV hygiene: per-step liveness-barrier arrive
+      // keys would otherwise accumulate unboundedly in long runs)
+      {
+        std::lock_guard<std::mutex> l(srv->mu);
+        srv->kv.erase(a);
+      }
+      if (!send_msg(fd, "OK")) break;
     } else if (op == 'H') {
       {
         std::lock_guard<std::mutex> l(srv->mu);
@@ -293,6 +301,14 @@ int coord_barrier(void* h, const char* name, int count) {
   req += name;
   req += '\0';
   req += std::to_string(count);
+  std::string resp;
+  return roundtrip(c, req, &resp) == 0 && resp == "OK" ? 0 : -1;
+}
+
+int coord_del(void* h, const char* key) {
+  Client* c = static_cast<Client*>(h);
+  std::string req = "D";
+  req += key;
   std::string resp;
   return roundtrip(c, req, &resp) == 0 && resp == "OK" ? 0 : -1;
 }

@@ -39,6 +39,7 @@ class Fleet:
         self._server = None
         self._client = None
         self._initialized = False
+        self._done_barriers: list = []
 
     # --- lifecycle (reference: fleet_base.py init/init_worker) ---
 
@@ -92,6 +93,7 @@ class Fleet:
         return self
 
     def stop_worker(self):
+        self._done_barriers = []
         if self._client is not None:
             try:
                 self._client.close()
@@ -173,6 +175,20 @@ class Fleet:
                 f"pass instantly on stale arrivals and silently lose the "
                 f"liveness protection. Use a unique name per barrier "
                 f"(e.g. interpolate the step index).")
+        # KV hygiene: reclaim MY arrive key from the barrier completed
+        # TWO generations ago. The two-barrier lag makes deletion safe
+        # without a server-side epoch: a peer still polling barrier N-2
+        # would mean it never completed N-2, so I could not have
+        # completed N-1 (which required that peer's N-2 arrival) and
+        # would not be entering N now. One key per worker stays live per
+        # in-flight barrier instead of growing with step count.
+        self._done_barriers.append(name)
+        if len(self._done_barriers) > 2:
+            old_name = self._done_barriers.pop(0)
+            try:
+                self._client.delete(f"fleet/arrive/{old_name}/{me}")
+            except OSError:
+                pass  # hygiene only; never fail the barrier for it
         self._client.put(key, b"1")
         deadline = _time.monotonic() + timeout_ms / 1000.0
         while True:

@@ -69,6 +69,8 @@ def _load() -> ctypes.CDLL:
     lib.coord_barrier.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
     lib.coord_heartbeat.restype = ctypes.c_int
     lib.coord_heartbeat.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.coord_del.restype = ctypes.c_int
+    lib.coord_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.coord_dead_peers.restype = ctypes.c_int
     lib.coord_dead_peers.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                      ctypes.c_char_p, ctypes.c_uint32]
@@ -223,6 +225,10 @@ class CoordClient:
     def heartbeat(self, worker_id: str):
         if self._lib.coord_heartbeat(self._h, worker_id.encode()) != 0:
             raise OSError("heartbeat failed")
+
+    def delete(self, key: str):
+        if self._lib.coord_del(self._h, key.encode()) != 0:
+            raise OSError("coord delete failed")
 
     def dead_peers(self, max_age_ms: int) -> List[str]:
         out = ctypes.create_string_buffer(1 << 16)

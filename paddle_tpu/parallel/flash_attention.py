@@ -756,7 +756,7 @@ def _head(x2, hi, dh):
     return x2[:, hi * dh:(hi + 1) * dh]   # lane slice: (t, dh)
 
 
-def _scores_head(q2, k2, hi, dh, scale, bias_ref, hb):
+def _scores_head(q2, k2, hi, dh, scale, bias_ref, hb, extra_mask=None):
     s = jax.lax.dot_general(
         _head(q2, hi, dh), _head(k2, hi, dh), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -764,6 +764,8 @@ def _scores_head(q2, k2, hi, dh, scale, bias_ref, hb):
     if bias_ref is not None:
         b2 = bias_ref[0, min(hi, hb - 1)]  # (1|cq, tk)
         s = s + b2.astype(jnp.float32)
+    if extra_mask is not None:             # causal: True = keep
+        s = jnp.where(extra_mask, s, _NEG_INF)
     return s
 
 
@@ -798,12 +800,12 @@ def _fwd_small_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
 
 
 def _bwd_head_grads(q2, k2, v2, do2, lse2, delta2, bias_ref, scale, p_drop,
-                    h, dh, hb, drop_fn):
+                    h, dh, hb, drop_fn, extra_mask=None):
     """Shared per-head backward phase: recompute scores, p = exp(s - lse),
     dp = do @ v^T, then (pds, dss) with the dropout mask applied
     identically to p and dp while dss uses the UNdropped p — the invariant
     both the single-block and K-blocked fused backwards must hold."""
-    ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
+    ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb, extra_mask)
           for hi in range(h)]
     ps = [jnp.exp(s - lse2[:, hi:hi + 1]) for hi, s in enumerate(ss)]
     dps = [jax.lax.dot_general(
@@ -905,6 +907,15 @@ def _kb_dropout(seed_ref, i, j, cq, hi, kk, bk, p_drop):
                             lambda jabs: jabs * 4096 + kk)
 
 
+def _kb_causal_mask(cq, bk, j, kk):
+    """(cq, bk) keep-mask for q-chunk j / k-block kk. Forward and
+    backward MUST share this (and _causal_live for the dead-block skip)
+    or the recomputed backward p diverges from the forward."""
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (cq, bk), 0) + j * cq
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (cq, bk), 1) + kk * bk
+    return qpos >= kpos
+
+
 def _bias_spec_kb(bias, cq, bk):
     hb, tq_b = bias.shape[1], bias.shape[2]
     if tq_b == 1:
@@ -916,7 +927,7 @@ def _bias_spec_kb(bias, cq, bk):
 
 def _fwd_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                    m_scr, l_scr, acc_scr, *, scale, p_drop, nk, h, dh, hb,
-                   bk):
+                   bk, causal=False):
     i, j, kk = pl.program_id(0), pl.program_id(1), pl.program_id(2)
 
     @pl.when(kk == 0)
@@ -925,39 +936,51 @@ def _fwd_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q2, k2, v2 = q_ref[0], k_ref[0], v_ref[0]   # (cq, hdh) / (bk, hdh)
-    cq = q2.shape[0]
-    # Phase-split with ONE batched read-modify-write of each scratch per
-    # program (per-head scratch RMW serialized the loop: measured
-    # 0.78 ms/call before, vs 0.087 analytic, at t=1024).
-    ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb)
-          for hi in range(h)]                    # (cq, bk) each
-    m_prev = m_scr[...]                          # (cq, h)
-    l_prev = l_scr[...]
-    m_new = jnp.concatenate(
-        [jnp.maximum(m_prev[:, hi:hi + 1],
-                     jnp.max(ss[hi], axis=-1, keepdims=True))
-         for hi in range(h)], axis=-1)           # (cq, h)
-    ps = [jnp.exp(ss[hi] - m_new[:, hi:hi + 1]) for hi in range(h)]
-    corr = jnp.exp(m_prev - m_new)               # (cq, h)
-    l_scr[...] = l_prev * corr + jnp.concatenate(
-        [jnp.sum(p, axis=-1, keepdims=True) for p in ps], axis=-1)
-    m_scr[...] = m_new
-    if p_drop > 0.0:
-        ps = [p * _kb_dropout(seed_ref, i, j, cq, hi, kk, bk, p_drop)
-              for hi, p in enumerate(ps)]
-    pv = jnp.concatenate(
-        [jax.lax.dot_general(
-            p.astype(v2.dtype), _head(v2, hi, dh), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-         for hi, p in enumerate(ps)], axis=-1)   # (cq, hdh)
-    corr_full = jnp.concatenate(
-        [jnp.broadcast_to(corr[:, hi:hi + 1], (cq, dh)) for hi in range(h)],
-        axis=-1)
-    acc_scr[...] = acc_scr[...] * corr_full + pv
+    def _compute():
+        q2, k2, v2 = q_ref[0], k_ref[0], v_ref[0]  # (cq, hdh) / (bk, hdh)
+        cq = q2.shape[0]
+        mask = _kb_causal_mask(cq, bk, j, kk) if causal else None
+        # Phase-split with ONE batched read-modify-write of each scratch
+        # per program (per-head scratch RMW serialized the loop: measured
+        # 0.78 ms/call before, vs 0.087 analytic, at t=1024).
+        ss = [_scores_head(q2, k2, hi, dh, scale, bias_ref, hb, mask)
+              for hi in range(h)]                    # (cq, bk) each
+        m_prev = m_scr[...]                          # (cq, h)
+        l_prev = l_scr[...]
+        m_new = jnp.concatenate(
+            [jnp.maximum(m_prev[:, hi:hi + 1],
+                         jnp.max(ss[hi], axis=-1, keepdims=True))
+             for hi in range(h)], axis=-1)           # (cq, h)
+        ps = [jnp.exp(ss[hi] - m_new[:, hi:hi + 1]) for hi in range(h)]
+        corr = jnp.exp(m_prev - m_new)               # (cq, h)
+        l_scr[...] = l_prev * corr + jnp.concatenate(
+            [jnp.sum(p, axis=-1, keepdims=True) for p in ps], axis=-1)
+        m_scr[...] = m_new
+        if p_drop > 0.0:
+            ps = [p * _kb_dropout(seed_ref, i, j, cq, hi, kk, bk, p_drop)
+                  for hi, p in enumerate(ps)]
+        pv = jnp.concatenate(
+            [jax.lax.dot_general(
+                p.astype(v2.dtype), _head(v2, hi, dh),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+             for hi, p in enumerate(ps)], axis=-1)   # (cq, hdh)
+        corr_full = jnp.concatenate(
+            [jnp.broadcast_to(corr[:, hi:hi + 1], (cq, dh))
+             for hi in range(h)], axis=-1)
+        acc_scr[...] = acc_scr[...] * corr_full + pv
+
+    if causal:
+        # fully-future k-blocks contribute nothing: skip their matmuls
+        # outright (kk=0 is live for every chunk, so scratch always
+        # holds valid running stats before _finish)
+        pl.when(_causal_live(j, kk, q_ref.shape[1], bk))(_compute)
+    else:
+        _compute()
 
     @pl.when(kk == nk - 1)
     def _finish():
+        cq = q_ref.shape[1]
         l_all = l_scr[...]
         recip_full = jnp.concatenate(
             [jnp.broadcast_to(jax.lax.reciprocal(l_all[:, hi:hi + 1]),
@@ -969,7 +992,7 @@ def _fwd_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
 def _dqdkv_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
                      lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
                      dq_scr, dk_scr, dv_scr, *, scale, p_drop, nq, nk, h,
-                     dh, hb, bk):
+                     dh, hb, bk, causal=False):
     """Fused k-blocked backward: dq accumulates over kk per q-chunk;
     dk/dv accumulate into FULL-length (tk, h*dh) f32 scratch across the
     whole (j, kk) walk and are emitted once at the last program."""
@@ -984,30 +1007,43 @@ def _dqdkv_kb_kernel(seed_ref, q_ref, k_ref, v_ref, bias_ref, do_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
-    lse2, delta2 = lse_ref[0], delta_ref[0]         # (cq, h)
-    cq = q2.shape[0]
-    pds, dss = _bwd_head_grads(
-        q2, k2, v2, do2, lse2, delta2, bias_ref, scale, p_drop, h, dh, hb,
-        lambda hi: _kb_dropout(seed_ref, i, j, cq, hi, kk, bk, p_drop))
-    # Batched scratch RMW: one load+store per scratch per program instead
-    # of per head (per-head RMW serializes against the matmuls).
-    dq_scr[...] += jnp.concatenate(
-        [jax.lax.dot_general(
-            ds.astype(k2.dtype), _head(k2, hi, dh), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-         for hi, ds in enumerate(dss)], axis=-1)
-    rows = pl.ds(kk * bk, bk)
-    dv_scr[rows, :] += jnp.concatenate(
-        [jax.lax.dot_general(
-            pd.astype(do2.dtype), _head(do2, hi, dh),
-            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-         for hi, pd in enumerate(pds)], axis=-1)
-    dk_scr[rows, :] += jnp.concatenate(
-        [jax.lax.dot_general(
-            ds.astype(q2.dtype), _head(q2, hi, dh),
-            (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-         for hi, ds in enumerate(dss)], axis=-1)
+    def _compute():
+        q2, k2, v2, do2 = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse2, delta2 = lse_ref[0], delta_ref[0]         # (cq, h)
+        cq = q2.shape[0]
+        mask = _kb_causal_mask(cq, bk, j, kk) if causal else None
+        pds, dss = _bwd_head_grads(
+            q2, k2, v2, do2, lse2, delta2, bias_ref, scale, p_drop, h, dh,
+            hb,
+            lambda hi: _kb_dropout(seed_ref, i, j, cq, hi, kk, bk, p_drop),
+            extra_mask=mask)
+        # Batched scratch RMW: one load+store per scratch per program
+        # instead of per head (per-head RMW serializes against the
+        # matmuls).
+        dq_scr[...] += jnp.concatenate(
+            [jax.lax.dot_general(
+                ds.astype(k2.dtype), _head(k2, hi, dh),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+             for hi, ds in enumerate(dss)], axis=-1)
+        rows = pl.ds(kk * bk, bk)
+        dv_scr[rows, :] += jnp.concatenate(
+            [jax.lax.dot_general(
+                pd.astype(do2.dtype), _head(do2, hi, dh),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+             for hi, pd in enumerate(pds)], axis=-1)
+        dk_scr[rows, :] += jnp.concatenate(
+            [jax.lax.dot_general(
+                ds.astype(q2.dtype), _head(q2, hi, dh),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+             for hi, ds in enumerate(dss)], axis=-1)
+
+    if causal:
+        pl.when(_causal_live(j, kk, q_ref.shape[1], bk))(_compute)
+    else:
+        _compute()
 
     @pl.when(kk == nk - 1)
     def _emit_dq():
@@ -1034,7 +1070,7 @@ def _use_bthd_kblock(tq, tk, h, dh):
     )
 
 
-def _bthd_kb_fwd(q, k, v, bias, seed, scale, p_drop):
+def _bthd_kb_fwd(q, k, v, bias, seed, scale, p_drop, causal=False):
     b, tq, h, dh = q.shape
     tk = k.shape[1]
     bk = _pick_bk(tk, h, dh)
@@ -1054,13 +1090,14 @@ def _bthd_kb_fwd(q, k, v, bias, seed, scale, p_drop):
         args.append(bias)
         kernel = functools.partial(_fwd_kb_kernel, scale=scale,
                                    p_drop=p_drop, nk=nk, h=h, dh=dh, hb=hb,
-                                   bk=bk)
+                                   bk=bk, causal=causal)
     else:
         kernel = functools.partial(
             lambda sr, qr, kr, vr, orf, lr, ms, ls, ac, **kw:
                 _fwd_kb_kernel(sr, qr, kr, vr, None, orf, lr, ms, ls, ac,
                                **kw),
             scale=scale, p_drop=p_drop, nk=nk, h=h, dh=dh, hb=hb, bk=bk,
+            causal=causal,
         )
     out2, lse2 = pl.pallas_call(
         kernel,
@@ -1087,7 +1124,8 @@ def _bthd_kb_fwd(q, k, v, bias, seed, scale, p_drop):
     return out2.reshape(b, tq, h, dh), lse2[..., None]
 
 
-def _bthd_kb_bwd(q, k, v, bias, seed, out, lse, g, scale, p_drop):
+def _bthd_kb_bwd(q, k, v, bias, seed, out, lse, g, scale, p_drop,
+                 causal=False):
     b, tq, h, dh = q.shape
     tk = k.shape[1]
     bk = _pick_bk(tk, h, dh)
@@ -1116,14 +1154,14 @@ def _bthd_kb_bwd(q, k, v, bias, seed, out, lse, g, scale, p_drop):
     if bias is not None:
         kernel = functools.partial(_dqdkv_kb_kernel, scale=scale,
                                    p_drop=p_drop, nq=nq, nk=nk, h=h, dh=dh,
-                                   hb=hb, bk=bk)
+                                   hb=hb, bk=bk, causal=causal)
     else:
         kernel = functools.partial(
             lambda sr, qr, kr, vr, dor, lr, der, dqr, dkr, dvr, dqs, dks,
             dvs, **kw: _dqdkv_kb_kernel(sr, qr, kr, vr, None, dor, lr, der,
                                         dqr, dkr, dvr, dqs, dks, dvs, **kw),
             scale=scale, p_drop=p_drop, nq=nq, nk=nk, h=h, dh=dh, hb=hb,
-            bk=bk,
+            bk=bk, causal=causal,
         )
     dq2, dk2, dv2 = pl.pallas_call(
         kernel,
@@ -1201,9 +1239,8 @@ def flash_attention_bthd_fwd(q, k, v, bias=None, seed=None, scale=None,
         scale = 1.0 / math.sqrt(dh)
     if not _use_bthd_small(tq, tk):
         if _use_bthd_kblock(tq, tk, h, dh):
-            if causal:
-                bias = _combined_causal_bias(bias, tq, tk)
-            return _bthd_kb_fwd(q, k, v, bias, seed, scale, p_drop)
+            return _bthd_kb_fwd(q, k, v, bias, seed, scale, p_drop,
+                                causal=causal)
         if (jax.default_backend() == "tpu" or _INTERPRET) and tk > _SMALL_T_MAX:
             # very long context: one transpose pair into the head-batched
             # K-blocked kernels (dk/dv won't fit VMEM scratch as one
@@ -1274,10 +1311,8 @@ def flash_attention_bthd_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
         scale = 1.0 / math.sqrt(dh)
     if not _use_bthd_small(tq, tk):
         if _use_bthd_kblock(tq, tk, h, dh):
-            if causal:
-                bias = _combined_causal_bias(bias, tq, tk)
             return _bthd_kb_bwd(q, k, v, bias, seed, out, lse, g, scale,
-                                p_drop)
+                                p_drop, causal=causal)
         if (jax.default_backend() == "tpu" or _INTERPRET) and tk > _SMALL_T_MAX:
             dq, dk, dv = flash_attention_bwd(
                 jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
