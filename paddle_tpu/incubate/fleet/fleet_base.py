@@ -163,33 +163,38 @@ class Fleet:
         import time as _time
 
         me = self.worker_index()
-        key = f"fleet/arrive/{name}/{me}"
-        try:
-            self._client.get(key, timeout_ms=0)
-        except TimeoutError:
-            pass  # fresh name, as required
-        else:
+        # Reuse guard over ALL ranks' keys: any surviving arrive key for
+        # this name — mine or a lagging peer's not yet reclaimed — would
+        # make the reused barrier pass instantly on a stale arrival, so
+        # it is a loud error. Once every rank's key has been reclaimed
+        # (two fully-completed barriers later, below) the name is
+        # genuinely fresh and reuse is a correct new barrier.
+        for r in range(self.worker_num()):
+            try:
+                self._client.get(f"fleet/arrive/{name}/{r}", timeout_ms=0)
+            except TimeoutError:
+                continue
             raise ValueError(
-                f"barrier_or_dead name {name!r} was already used: arrive "
-                f"keys persist in the coordination KV, so reuse would "
-                f"pass instantly on stale arrivals and silently lose the "
-                f"liveness protection. Use a unique name per barrier "
-                f"(e.g. interpolate the step index).")
-        # KV hygiene: reclaim MY arrive key from the barrier completed
-        # TWO generations ago. The two-barrier lag makes deletion safe
-        # without a server-side epoch: a peer still polling barrier N-2
-        # would mean it never completed N-2, so I could not have
-        # completed N-1 (which required that peer's N-2 arrival) and
-        # would not be entering N now. One key per worker stays live per
-        # in-flight barrier instead of growing with step count.
-        self._done_barriers.append(name)
-        if len(self._done_barriers) > 2:
+                f"barrier_or_dead name {name!r} still has live arrive "
+                f"keys (rank {r}): reuse would pass instantly on stale "
+                f"arrivals and silently lose the liveness protection. "
+                f"Use a unique name per barrier (e.g. interpolate the "
+                f"step index).")
+        # KV hygiene: reclaim MY arrive key from the OLDER of the last
+        # two FULLY-completed barriers. Full completion of the newer one
+        # required every peer to arrive there, hence to have LEFT the
+        # older one — no live peer can still be polling the key being
+        # deleted, however the peers' own returns happened. Dead-path
+        # returns clear this history (no reclamation until two fresh
+        # full completions), because a falsely-dead-but-alive straggler
+        # may still be polling an older barrier whose keys it needs.
+        if len(self._done_barriers) >= 2:
             old_name = self._done_barriers.pop(0)
             try:
                 self._client.delete(f"fleet/arrive/{old_name}/{me}")
             except OSError:
                 pass  # hygiene only; never fail the barrier for it
-        self._client.put(key, b"1")
+        self._client.put(f"fleet/arrive/{name}/{me}", b"1")
         deadline = _time.monotonic() + timeout_ms / 1000.0
         while True:
             self._client.heartbeat(f"worker-{me}")
@@ -203,11 +208,13 @@ class Fleet:
                 except TimeoutError:
                     missing.append(r)
             if not missing:
+                self._done_barriers.append(name)
                 return []
             dead = list(self._client.dead_peers(max_age_ms))
             dead_missing = [d for d in dead
                             if any(d == f"worker-{r}" for r in missing)]
             if dead_missing:
+                self._done_barriers = []
                 return dead_missing
             if _time.monotonic() > deadline:
                 raise TimeoutError(

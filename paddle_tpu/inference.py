@@ -12,6 +12,7 @@ reference's TensorRT/int8 engines.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -54,14 +55,32 @@ class Predictor:
             TPUPlace(0) if config._use_tpu else CPUPlace()
         )
         with scope_guard(self.scope):
-            self.program, self._feed_names, self._fetch_vars = (
-                _io.load_inference_model(
-                    config.model_dir,
-                    self._exe,
-                    model_filename=config.model_filename,
-                    params_filename=config.params_filename,
+            if os.path.exists(os.path.join(config.model_dir,
+                                           "__params_int8__.npz")):
+                # int8 PTQ artifact (slim.calibration
+                # save_int8_inference_model): quantizable-op weights
+                # dequantize from the int8 snapshot, everything else
+                # (BN stats, biases) loads fp32; the frozen program
+                # carries the static-scale QDQ ops, so serving numerics
+                # match int8 deployment through the same Predictor/C-ABI
+                # surface as float artifacts.
+                from paddle_tpu.slim.calibration import (
+                    load_int8_inference_model,
                 )
-            )
+
+                self.program, self._feed_names, self._fetch_vars = (
+                    load_int8_inference_model(
+                        config.model_dir, self._exe, scope=self.scope)
+                )
+            else:
+                self.program, self._feed_names, self._fetch_vars = (
+                    _io.load_inference_model(
+                        config.model_dir,
+                        self._exe,
+                        model_filename=config.model_filename,
+                        params_filename=config.params_filename,
+                    )
+                )
         if config._use_bf16:
             self.program._amp = True
 

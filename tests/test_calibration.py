@@ -177,3 +177,33 @@ def test_calibrator_kl_matches_exact_sweep():
         exact = _kl_scale(raw[n])
         amax = max(float(np.abs(v).max()) for v in raw[n])
         assert abs(s - exact) <= amax * 16 / 2048 + 1e-6, (n, s, exact)
+
+
+def test_predictor_serves_int8_artifact(tmp_path):
+    """The Predictor (and therefore the native C ABI built on it)
+    auto-detects an int8 PTQ artifact and serves it with quantized
+    numerics — the calibrate -> export -> serve loop closes through the
+    same surface float artifacts use."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    infer, logits, exe, scope, rng = _train_mnist_mlp(steps=10)
+    with fluid.scope_guard(scope):
+        calib = Calibrator(infer, exe, scope=scope, algo="abs_max")
+        for _ in range(2):
+            calib.sample({"img": rng.normal(0, 1, (32, 784)).astype(
+                np.float32)})
+        save_int8_inference_model(str(tmp_path / "i8"), ["img"],
+                                  [logits], exe, infer, calib, scope=scope)
+        x = rng.normal(0, 1, (16, 784)).astype(np.float32)
+        (ref,) = exe.run(infer, feed={"img": x}, fetch_list=[logits])
+
+    cfg = Config(str(tmp_path / "i8"))
+    cfg.disable_tpu()
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["img"]
+    (got,) = pred.run({"img": x})
+    ref, got = np.asarray(ref), np.asarray(got)
+    agree = (np.argmax(ref, 1) == np.argmax(got, 1)).mean()
+    assert agree >= 0.9, agree
+    err = np.abs(ref - got).max() / np.abs(ref).max()
+    assert 0 < err < 0.15, err  # quantized-but-close, not float-equal

@@ -129,3 +129,32 @@ def test_fleet_kv_and_liveness_single_process():
         assert f.dead_workers(max_age_ms=60_000) == []
     finally:
         f.stop_worker()
+
+
+def test_barrier_or_dead_key_reclamation_and_reuse_guard():
+    """Arrive keys are reclaimed two fully-completed barriers later
+    (bounded KV growth), and reusing a name whose keys still live is a
+    loud error rather than an instant stale pass."""
+    from paddle_tpu import native
+
+    if not native.available():
+        pytest.skip("native library not built")
+    port = _free_port()
+    f = _fleet.__class__()
+    f._role = UserDefinedRoleMaker(current_id=0, worker_num=1,
+                                   coord_endpoint=f"127.0.0.1:{port}")
+    f._server = native.CoordServer(port)
+    f._client = native.CoordClient("127.0.0.1", port)
+    try:
+        assert f.barrier_or_dead("s0") == []
+        with pytest.raises(ValueError, match="live arrive keys"):
+            f.barrier_or_dead("s0")  # keys still present
+        assert f.barrier_or_dead("s1") == []
+        assert f.barrier_or_dead("s2") == []  # entering s2 reclaims s0
+        f.barrier_or_dead("s0")  # s0's keys reclaimed -> fresh barrier
+        # s1 reclaimed when entering s0 above; s2/s0 still live
+        with pytest.raises(TimeoutError):
+            f._client.get("fleet/arrive/s1/0", timeout_ms=0)
+        assert f._client.get("fleet/arrive/s2/0", timeout_ms=0) == b"1"
+    finally:
+        f.stop_worker()
