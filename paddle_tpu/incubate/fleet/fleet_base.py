@@ -40,6 +40,7 @@ class Fleet:
         self._client = None
         self._initialized = False
         self._done_barriers: list = []
+        self._barrier_seq = 0
 
     # --- lifecycle (reference: fleet_base.py init/init_worker) ---
 
@@ -163,23 +164,15 @@ class Fleet:
         import time as _time
 
         me = self.worker_index()
-        # Reuse guard over ALL ranks' keys: any surviving arrive key for
-        # this name — mine or a lagging peer's not yet reclaimed — would
-        # make the reused barrier pass instantly on a stale arrival, so
-        # it is a loud error. Once every rank's key has been reclaimed
-        # (two fully-completed barriers later, below) the name is
-        # genuinely fresh and reuse is a correct new barrier.
-        for r in range(self.worker_num()):
-            try:
-                self._client.get(f"fleet/arrive/{name}/{r}", timeout_ms=0)
-            except TimeoutError:
-                continue
-            raise ValueError(
-                f"barrier_or_dead name {name!r} still has live arrive "
-                f"keys (rank {r}): reuse would pass instantly on stale "
-                f"arrivals and silently lose the liveness protection. "
-                f"Use a unique name per barrier (e.g. interpolate the "
-                f"step index).")
+        # Epoch-keyed arrivals: every call gets this client's barrier
+        # SEQUENCE NUMBER in the key. All workers reach their N-th
+        # barrier_or_dead call together (the same SPMD contract any
+        # collective requires), so the epoch matches across ranks — and
+        # a reused name lands in a fresh epoch namespace, so a stale
+        # arrive key from an earlier barrier can never satisfy a later
+        # one. No reuse guard needed; names need not be unique.
+        self._barrier_seq += 1
+        tag = f"{self._barrier_seq}:{name}"
         # KV hygiene: reclaim MY arrive key from the OLDER of the last
         # two FULLY-completed barriers. Full completion of the newer one
         # required every peer to arrive there, hence to have LEFT the
@@ -189,12 +182,12 @@ class Fleet:
         # full completions), because a falsely-dead-but-alive straggler
         # may still be polling an older barrier whose keys it needs.
         if len(self._done_barriers) >= 2:
-            old_name = self._done_barriers.pop(0)
+            old_tag = self._done_barriers.pop(0)
             try:
-                self._client.delete(f"fleet/arrive/{old_name}/{me}")
+                self._client.delete(f"fleet/arrive/{old_tag}/{me}")
             except OSError:
                 pass  # hygiene only; never fail the barrier for it
-        self._client.put(f"fleet/arrive/{name}/{me}", b"1")
+        self._client.put(f"fleet/arrive/{tag}/{me}", b"1")
         deadline = _time.monotonic() + timeout_ms / 1000.0
         while True:
             self._client.heartbeat(f"worker-{me}")
@@ -203,12 +196,12 @@ class Fleet:
                 if r == me:
                     continue
                 try:
-                    self._client.get(f"fleet/arrive/{name}/{r}",
+                    self._client.get(f"fleet/arrive/{tag}/{r}",
                                      timeout_ms=0)
                 except TimeoutError:
                     missing.append(r)
             if not missing:
-                self._done_barriers.append(name)
+                self._done_barriers.append(tag)
                 return []
             dead = list(self._client.dead_peers(max_age_ms))
             dead_missing = [d for d in dead

@@ -322,8 +322,10 @@ def _bias_spec(bias, bq, bk, *, transposed=False):
     return pl.BlockSpec((1, hb, qdim, bk), idx)
 
 
-def _reference_attention(q, k, v, bias, scale, p_drop=0.0, seed=None,
-                         causal=False):
+def _reference_scores(q, k, bias, scale, causal):
+    """Scaled scores + bias + causal mask — the ONE copy both the dense
+    forward and its lse statistic derive from (the ring-attention merge
+    combines (out, lse), so they must never desynchronize)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
@@ -332,6 +334,12 @@ def _reference_attention(q, k, v, bias, scale, p_drop=0.0, seed=None,
         tq, tk = q.shape[2], k.shape[2]
         mask = (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])
         s = jnp.where(mask[None, None], s, _NEG_INF)
+    return s
+
+
+def _reference_attention(q, k, v, bias, scale, p_drop=0.0, seed=None,
+                         causal=False):
+    s = _reference_scores(q, k, bias, scale, causal)
     p = jax.nn.softmax(s, axis=-1)
     if p_drop > 0.0:
         key = jax.random.PRNGKey(0 if seed is None else jnp.asarray(seed))
@@ -365,8 +373,9 @@ def flash_attention_fwd(q, k, v, bias=None, seed=None, scale=None,
                         q_block: int = DEFAULT_Q_BLOCK,
                         k_block: int = DEFAULT_K_BLOCK,
                         causal: bool = False):
-    """-> (out, lse) with lse [b, h, tq, 1] f32 (zeros on the dense path,
-    which needs no saved stats: its backward recomputes via vjp).
+    """-> (out, lse) with lse [b, h, tq, 1] f32 — REAL logsumexp rows on
+    every path including the dense fallback (the ring-attention merge
+    consumes them; the fallback backward still recomputes via vjp).
 
     ``causal=True`` applies the future mask IN-KERNEL (block-position
     iota compare) and skips fully-masked k-blocks outright — no [tq, tk]
@@ -388,7 +397,15 @@ def flash_attention_fwd(q, k, v, bias=None, seed=None, scale=None,
         out = _reference_attention(q, k, v, bias, scale, p_drop,
                                    seed if p_drop > 0.0 else None,
                                    causal=causal)
-        return out, jnp.zeros((b, h, tq, 1), jnp.float32)
+        # REAL logsumexp rows, not placeholder zeros: the ring-attention
+        # merge combines per-block (o, lse) partials, so the fallback
+        # must report the same statistic the kernels do, derived from
+        # the SAME score construction (_reference_scores). (The backward
+        # never reads fallback lse — it vjps the dense composition.)
+        s = _reference_scores(q.astype(jnp.float32),
+                              k.astype(jnp.float32), bias, scale, causal)
+        lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+        return out, lse
 
     nq, nk = tq // bq, tk // bk
     in_specs = [
@@ -438,8 +455,15 @@ def flash_attention_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
                         p_drop: float = 0.0,
                         q_block: int = DEFAULT_Q_BLOCK,
                         k_block: int = DEFAULT_K_BLOCK,
-                        causal: bool = False):
-    """-> (dq, dk, dv), consuming the forward's saved (out, lse)."""
+                        causal: bool = False, g_lse=None):
+    """-> (dq, dk, dv), consuming the forward's saved (out, lse).
+
+    ``g_lse``: optional cotangent of the lse OUTPUT ([b, h, tq, 1]).
+    The lse rows are a real differentiated quantity for consumers like
+    the ring-attention merge (block weights exp(lse_blk - lse_comb)).
+    dlse/ds = p, so the lse cotangent phi folds EXACTLY into the
+    existing backward as ds = p*(dp - (delta - phi)) — one subtraction
+    on the per-row delta, no kernel changes."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     b, h, tq, dh = q.shape
@@ -447,16 +471,24 @@ def flash_attention_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
     bq, bk = _pick_blocks(h, tq, tk, q_block, k_block)
     if not _use_pallas(tq, tk, bq, bk):
         def f(q, k, v):
-            return _reference_attention(q, k, v, bias, scale, p_drop,
+            out_ = _reference_attention(q, k, v, bias, scale, p_drop,
                                         seed if p_drop > 0.0 else None,
                                         causal=causal)
+            s = _reference_scores(q.astype(jnp.float32),
+                                  k.astype(jnp.float32), bias, scale,
+                                  causal)
+            lse_ = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+            return out_, lse_
 
         _, vjp = jax.vjp(f, q, k, v)
-        return vjp(g)
+        return vjp((g, jnp.zeros((b, h, tq, 1), jnp.float32)
+                    if g_lse is None else g_lse))
 
     nq, nk = tq // bq, tk // bk
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [b, h, tq, 1]
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     seed_arr = _seed_arr(seed)
 
     # --- dq: grid (b, nq, nk), k-blocks inner ---
@@ -579,7 +611,8 @@ def _vjp_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block,
     return out, (q, k, v, bias, seed, out, lse)
 
 
-def _vjp_bwd(scale, p_drop, q_block, k_block, causal, res, g):
+def _vjp_bwd(scale, p_drop, q_block, k_block, causal, res, g,
+             g_lse=None):
     q, k, v, bias, seed, out, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -588,23 +621,31 @@ def _vjp_bwd(scale, p_drop, q_block, k_block, causal, res, g):
     if _use_pallas(q.shape[2], k.shape[2], bq, bk):
         dq, dk, dv = flash_attention_bwd(q, k, v, bias, seed, out, lse, g,
                                          scale, p_drop, q_block, k_block,
-                                         causal)
+                                         causal, g_lse=g_lse)
         # Pallas path: bias is mask plumbing, cotangent intentionally zero
         # (see module docstring).
         dbias = None if bias is None else jnp.zeros_like(bias)
     else:
         sd = seed if p_drop > 0.0 else None
+        glse = (jnp.zeros_like(lse) if g_lse is None else g_lse)
+
+        def out_and_lse(a, b, c, bb):
+            out_ = _reference_attention(a, b, c, bb, scale, p_drop, sd,
+                                        causal)
+            s = _reference_scores(a.astype(jnp.float32),
+                                  b.astype(jnp.float32), bb, scale,
+                                  causal)
+            return out_, jax.scipy.special.logsumexp(
+                s, axis=-1, keepdims=True)
+
         if bias is None:
             _, vjp = jax.vjp(
-                lambda a, b, c: _reference_attention(
-                    a, b, c, None, scale, p_drop, sd, causal), q, k, v)
-            dq, dk, dv = vjp(g)
+                lambda a, b, c: out_and_lse(a, b, c, None), q, k, v)
+            dq, dk, dv = vjp((g, glse))
             dbias = None
         else:
-            _, vjp = jax.vjp(
-                lambda a, b, c, bb: _reference_attention(
-                    a, b, c, bb, scale, p_drop, sd, causal), q, k, v, bias)
-            dq, dk, dv, dbias = vjp(g)
+            _, vjp = jax.vjp(out_and_lse, q, k, v, bias)
+            dq, dk, dv, dbias = vjp((g, glse))
     return dq, dk, dv, dbias, _seed_cotangent(seed)
 
 
@@ -645,10 +686,10 @@ def _fa_lse_vjp_fwd(q, k, v, bias, seed, scale, p_drop, q_block, k_block,
 
 
 def _fa_lse_vjp_bwd(scale, p_drop, q_block, k_block, causal, res, gs):
-    g, _g_lse = gs  # lse is a saved statistic, not a training signal
+    g, g_lse = gs
     q = res[0]
     return _vjp_bwd(scale, p_drop, q_block, k_block, causal, res,
-                    g.astype(q.dtype))
+                    g.astype(q.dtype), g_lse=g_lse)
 
 
 flash_attention_with_lse.defvjp(_fa_lse_vjp_fwd, _fa_lse_vjp_bwd)

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+_NEG_INF = -1e30
+
 
 def _ring_attention_local(q, k, v, bias, *, axis_name: str, causal: bool,
                           scale: float):
@@ -27,9 +29,17 @@ def _ring_attention_local(q, k, v, bias, *, axis_name: str, causal: bool,
 
     q: [b, h, tq_loc, dh]; k, v: [b, h, tk_loc, dh] (this rank's block);
     bias: optional additive [b, 1|h, tq_loc, tk_GLOBAL] — the query dim is
-    sharded with q, the key dim stays global and is sliced per ring step
-    (bias tensors already encode causal+padding masks, so a bias-carrying
-    caller does not also pass ``causal``).
+    sharded with q, the key dim stays global and is sliced per ring step.
+
+    Each ring step attends q against ONE rotating K/V block through the
+    blocked flash kernels (parallel/flash_attention.py, O(block) HBM —
+    the [tq_loc, tk_loc] score matrix never materializes on TPU even
+    when per-rank chunks are themselves long), then merges the partial
+    (o, lse) pairs with the standard logsumexp combine. Causal routing
+    is BLOCK-level: source blocks entirely in the future are skipped
+    without touching the MXU (the ring analog of the kernels'
+    dead-block skip), the diagonal block runs the in-kernel causal
+    mask, past blocks run dense.
     """
     n = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -38,39 +48,59 @@ def _ring_attention_local(q, k, v, bias, *, axis_name: str, causal: bool,
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    q_f32 = q.astype(jnp.float32)
+    from paddle_tpu.parallel import flash_attention as fa
+
+    def _block(k_blk, v_blk, blk_bias, blk_causal):
+        # the custom-vjp wrapper, NOT flash_attention_fwd: the sdpa grad
+        # op differentiates ring_attention through jax.vjp, and a raw
+        # pallas_call has no JVP rule on TPU — the wrapper routes the
+        # backward through the blocked kernels
+        o_blk, lse_blk = fa.flash_attention_with_lse(
+            q, k_blk, v_blk, blk_bias, None, scale, 0.0,
+            causal=blk_causal)
+        return o_blk.astype(jnp.float32), lse_blk[..., 0]  # [b,h,tq]
 
     def step(carry, i):
-        k_blk, v_blk, m, l, o = carry
-        # source rank of this block: blocks rotate forward each step, so at
-        # step i we hold the block of rank (rank - i) mod n.
+        k_blk, v_blk, lse, o = carry
+        # source rank of this block: blocks rotate forward each step, so
+        # at step i we hold the block of rank (rank - i) mod n.
         src = (rank - i) % n
-        s = jnp.einsum("bhqd,bhkd->bhqk", q_f32, k_blk.astype(jnp.float32))
-        s = s * scale
+        blk_bias = None
         if bias is not None:
-            blk = jax.lax.dynamic_slice_in_dim(
-                bias, src * tk, tk, axis=3
-            )
-            s = s + blk.astype(jnp.float32)
+            blk_bias = jax.lax.dynamic_slice_in_dim(
+                bias, src * tk, tk, axis=3)
+
         if causal:
-            q_pos = rank * tq + jnp.arange(tq)
-            k_pos = src * tk + jnp.arange(tk)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, -1e9)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        correction = jnp.exp(m - m_new)
-        l_new = l * correction + jnp.sum(p, axis=-1)
-        o_new = o * correction[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
-        )
+            # tq == tk along the ring (same sequence sharded once); the
+            # diagonal needs the in-kernel mask, the past is dense, the
+            # future is skipped outright (identity on the carry).
+            def _past(_):
+                return _block(k_blk, v_blk, blk_bias, False)
+
+            def _diag(_):
+                return _block(k_blk, v_blk, blk_bias, True)
+
+            def _future(_):
+                return (jnp.zeros_like(o),
+                        jnp.full_like(lse, _NEG_INF))
+
+            case = jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
+            o_blk, lse_blk = jax.lax.switch(
+                case, (_past, _diag, _future), operand=None)
+        else:
+            o_blk, lse_blk = _block(k_blk, v_blk, blk_bias, False)
+
+        # logsumexp merge of two attention partials
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        w_old = jnp.exp(lse - lse_new)[..., None]
+        w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+        o_new = o * w_old + o_blk * w_blk
         k_next = jax.lax.ppermute(k_blk, axis_name, perm)
         v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return (k_next, v_next, m_new, l_new, o_new), None
+        return (k_next, v_next, lse_new, o_new), None
 
     b, h = q.shape[0], q.shape[1]
-    m0 = jnp.full((b, h, tq), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, h, tq), jnp.float32)
+    lse0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
     o0 = jnp.zeros((b, h, tq, q.shape[3]), jnp.float32)
     # initial carries are rank-invariant; mark them varying over every
     # sharded mesh axis (ring axis + any batch/data axis the inputs carry)
@@ -78,11 +108,11 @@ def _ring_attention_local(q, k, v, bias, *, axis_name: str, causal: bool,
     vary = tuple(
         a for a in (jax.typeof(q).vma | {axis_name}) if a is not None
     )
-    m0, l0, o0 = jax.lax.pcast((m0, l0, o0), vary, to="varying")
-    (k_f, v_f, m, l, o), _ = jax.lax.scan(
-        step, (k, v, m0, l0, o0), jnp.arange(n)
+    lse0, o0 = jax.lax.pcast((lse0, o0), vary, to="varying")
+    (k_f, v_f, lse, o), _ = jax.lax.scan(
+        step, (k, v, lse0, o0), jnp.arange(n)
     )
-    return (o / l[..., None]).astype(q.dtype)
+    return o.astype(q.dtype)
 
 
 def ring_attention(

@@ -360,3 +360,31 @@ def test_bthd_kb_native_causal_backward_matches():
     for a, r, name in ((dq, rq, "dq"), (dk, rk, "dk"), (dv, rv, "dv")):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    atol=5e-5, err_msg=name)
+
+
+def test_lse_cotangent_flows_through_blocked_backward():
+    """The lse OUTPUT is a real differentiated quantity (the ring merge
+    weights blocks by exp(lse_blk - lse_comb)); its cotangent folds into
+    the blocked backward as delta - phi. Checked against the dense
+    (out, lse) vjp through the interpret-mode kernels."""
+    q, k, v = _make_qkv(tq=256, tk=256)
+
+    def loss_wrapper(q, k, v):
+        out, lse = fa.flash_attention_with_lse(q, k, v, None, None,
+                                               None, 0.0)
+        return out.sum() + (lse * jnp.linspace(
+            0.1, 1.0, lse.shape[2])[None, None, :, None]).sum()
+
+    def loss_dense(q, k, v):
+        s = fa._reference_scores(q, k, None, 1.0 / np.sqrt(64), False)
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+        return out.sum() + (lse * jnp.linspace(
+            0.1, 1.0, lse.shape[2])[None, None, :, None]).sum()
+
+    g1 = jax.grad(loss_wrapper, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, err_msg=name)

@@ -131,10 +131,11 @@ def test_fleet_kv_and_liveness_single_process():
         f.stop_worker()
 
 
-def test_barrier_or_dead_key_reclamation_and_reuse_guard():
-    """Arrive keys are reclaimed two fully-completed barriers later
-    (bounded KV growth), and reusing a name whose keys still live is a
-    loud error rather than an instant stale pass."""
+def test_barrier_or_dead_epochs_and_key_reclamation():
+    """Every barrier_or_dead call is its own epoch (a per-client
+    sequence number namespaces the arrive keys, so a reused NAME can
+    never pass on a stale arrival), and keys are reclaimed two
+    fully-completed barriers later (bounded KV growth)."""
     from paddle_tpu import native
 
     if not native.available():
@@ -146,15 +147,13 @@ def test_barrier_or_dead_key_reclamation_and_reuse_guard():
     f._server = native.CoordServer(port)
     f._client = native.CoordClient("127.0.0.1", port)
     try:
-        assert f.barrier_or_dead("s0") == []
-        with pytest.raises(ValueError, match="live arrive keys"):
-            f.barrier_or_dead("s0")  # keys still present
-        assert f.barrier_or_dead("s1") == []
-        assert f.barrier_or_dead("s2") == []  # entering s2 reclaims s0
-        f.barrier_or_dead("s0")  # s0's keys reclaimed -> fresh barrier
-        # s1 reclaimed when entering s0 above; s2/s0 still live
+        assert f.barrier_or_dead("s") == []   # epoch 1
+        assert f.barrier_or_dead("s") == []   # SAME name, epoch 2: fresh
+        assert f._client.get("fleet/arrive/1:s/0", timeout_ms=0) == b"1"
+        assert f._client.get("fleet/arrive/2:s/0", timeout_ms=0) == b"1"
+        assert f.barrier_or_dead("s") == []   # epoch 3 reclaims epoch 1
         with pytest.raises(TimeoutError):
-            f._client.get("fleet/arrive/s1/0", timeout_ms=0)
-        assert f._client.get("fleet/arrive/s2/0", timeout_ms=0) == b"1"
+            f._client.get("fleet/arrive/1:s/0", timeout_ms=0)
+        assert f._client.get("fleet/arrive/3:s/0", timeout_ms=0) == b"1"
     finally:
         f.stop_worker()
