@@ -158,7 +158,14 @@ class Fleet:
         ``max_age_ms`` (returns the dead ids without blocking on them).
         Workers place this before each step's collectives so a peer
         crash surfaces as a recoverable signal instead of a hang in
-        psum. The caller keeps heartbeating while it polls."""
+        psum. The caller keeps heartbeating while it polls.
+
+        CONTRACT: calls form a collective sequence — every worker must
+        make its N-th call together (the same discipline any collective
+        requires; epochs are keyed by call count). A TimeoutError is
+        NOT retryable in place, and a replacement worker cannot join an
+        existing world mid-sequence: both must go through a fresh
+        rendezvous (new coord world), as the recovery protocol does."""
         if self._client is None:
             return []
         import time as _time

@@ -75,22 +75,23 @@ def _sdpa_config(ins, attrs, rng):
     return scale, drop, seed, use_pallas
 
 
-def _ring_config_t(q, k, drop, t_axis=2):
+def _ring_config_t(q, k, t_axis=2):
     """(mesh, context_axis, data_axis) when sequence-parallel ring
     attention applies, else None. Requires a strategy-declared context
     axis, BOTH sequence lengths divisible by the axis size (cross
-    attention has tq != tk), and no attention dropout (the ring kernel
-    computes the softmax online across rotating K/V blocks, so a
-    per-element dropout mask over the full row never exists on one
-    chip). Non-qualifying attention falls back to the flash/dense path.
-    ``t_axis`` is the sequence dim: 2 for BHTD, 1 for BTHD."""
+    attention has tq != tk). Attention dropout rides along since round
+    5: the flash-backed ring body draws an independent in-kernel mask
+    stream per rotating block (source-rank-mixed seed), regenerated
+    identically in forward and backward. Non-qualifying attention falls
+    back to the flash/dense path. ``t_axis`` is the sequence dim: 2 for
+    BHTD, 1 for BTHD."""
     from paddle_tpu.core.interp import spmd_ctx
 
     ctx = spmd_ctx()
     if ctx is None:
         return None
     mesh, ctx_axis, data_axis = ctx.mesh, ctx.context_axis, ctx.data_axis
-    if ctx_axis is None or drop > 0.0:
+    if ctx_axis is None:
         return None
     n = mesh.shape[ctx_axis]
     if (n <= 1 or jnp.shape(q)[t_axis] % n != 0
@@ -108,8 +109,8 @@ def _ring_config_t(q, k, drop, t_axis=2):
     return mesh, ctx_axis, data_axis
 
 
-def _ring_config(q, k, drop):
-    return _ring_config_t(q, k, drop, 2)
+def _ring_config(q, k):
+    return _ring_config_t(q, k, 2)
 
 
 @register_op("scaled_dot_product_attention", diff_inputs=("Q", "K", "V"),
@@ -133,7 +134,7 @@ def _sdpa(ins, attrs, rng=None):
     from paddle_tpu.parallel import flash_attention as fa
 
     t_axis = 1 if bthd else 2
-    ring = _ring_config_t(q, k, drop, t_axis)
+    ring = _ring_config_t(q, k, t_axis)
     if ring is not None:
         mesh, ctx_axis, data_axis = ring
         from paddle_tpu.parallel import ring_attention as ra
@@ -143,12 +144,13 @@ def _sdpa(ins, attrs, rng=None):
                 jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                 jnp.swapaxes(v, 1, 2), mesh, seq_axis=ctx_axis,
                 scale=scale, bias=bias, data_axis=data_axis,
-                causal=causal)
+                causal=causal, p_drop=float(drop), seed=seed)
             out = jnp.swapaxes(out, 1, 2)
         else:
             out = ra.ring_attention(q, k, v, mesh, seq_axis=ctx_axis,
                                     scale=scale, bias=bias,
-                                    data_axis=data_axis, causal=causal)
+                                    data_axis=data_axis, causal=causal,
+                                    p_drop=float(drop), seed=seed)
         lse = jnp.zeros(jnp.shape(q)[:3] + (1,), jnp.float32)
     elif bthd:
         if use_pallas:
@@ -192,7 +194,7 @@ def _sdpa_grad(ins, attrs, rng=None):
     from paddle_tpu.parallel import flash_attention as fa
 
     t_axis = 1 if bthd else 2
-    ring = _ring_config_t(q, k, drop, t_axis)
+    ring = _ring_config_t(q, k, t_axis)
     if ring is not None:
         mesh, ctx_axis, data_axis = ring
         from paddle_tpu.parallel import ring_attention as ra
@@ -203,11 +205,12 @@ def _sdpa_grad(ins, attrs, rng=None):
                     jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
                     jnp.swapaxes(v, 1, 2), mesh, seq_axis=ctx_axis,
                     scale=scale, bias=bias, data_axis=data_axis,
-                    causal=causal)
+                    causal=causal, p_drop=float(drop), seed=seed)
                 return jnp.swapaxes(o, 1, 2)
             return ra.ring_attention(
                 q, k, v, mesh, seq_axis=ctx_axis, scale=scale, bias=bias,
-                data_axis=data_axis, causal=causal,
+                data_axis=data_axis, causal=causal, p_drop=float(drop),
+                seed=seed,
             )
 
         _, vjp = jax.vjp(f, q, k, v)

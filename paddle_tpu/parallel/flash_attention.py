@@ -337,15 +337,26 @@ def _reference_scores(q, k, bias, scale, causal):
     return s
 
 
-def _reference_attention(q, k, v, bias, scale, p_drop=0.0, seed=None,
-                         causal=False):
+def _reference_attention_with_lse(q, k, v, bias, scale, p_drop=0.0,
+                                  seed=None, causal=False):
+    """(out, lse) from ONE score tensor — the fallback twin of the
+    kernels' contract. out and lse must never derive from separately
+    constructed scores (different dtype promotion would desynchronize
+    them at exactly the tolerance the ring merge relies on)."""
     s = _reference_scores(q, k, bias, scale, causal)
+    lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
     p = jax.nn.softmax(s, axis=-1)
     if p_drop > 0.0:
         key = jax.random.PRNGKey(0 if seed is None else jnp.asarray(seed))
         keep = jax.random.bernoulli(key, 1.0 - p_drop, p.shape)
         p = jnp.where(keep, p / (1.0 - p_drop), 0.0)
-    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v), lse
+
+
+def _reference_attention(q, k, v, bias, scale, p_drop=0.0, seed=None,
+                         causal=False):
+    return _reference_attention_with_lse(q, k, v, bias, scale, p_drop,
+                                         seed, causal)[0]
 
 
 def _seed_arr(seed):
@@ -394,18 +405,12 @@ def flash_attention_fwd(q, k, v, bias=None, seed=None, scale=None,
     tk = k.shape[2]
     bq, bk = _pick_blocks(h, tq, tk, q_block, k_block)
     if not _use_pallas(tq, tk, bq, bk):
-        out = _reference_attention(q, k, v, bias, scale, p_drop,
-                                   seed if p_drop > 0.0 else None,
-                                   causal=causal)
         # REAL logsumexp rows, not placeholder zeros: the ring-attention
-        # merge combines per-block (o, lse) partials, so the fallback
-        # must report the same statistic the kernels do, derived from
-        # the SAME score construction (_reference_scores). (The backward
-        # never reads fallback lse — it vjps the dense composition.)
-        s = _reference_scores(q.astype(jnp.float32),
-                              k.astype(jnp.float32), bias, scale, causal)
-        lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
-        return out, lse
+        # merge combines per-block (o, lse) partials, and both must
+        # derive from one score tensor (_reference_attention_with_lse).
+        return _reference_attention_with_lse(
+            q, k, v, bias, scale, p_drop,
+            seed if p_drop > 0.0 else None, causal=causal)
 
     nq, nk = tq // bq, tk // bk
     in_specs = [
@@ -471,14 +476,9 @@ def flash_attention_bwd(q, k, v, bias, seed, out, lse, g, scale=None,
     bq, bk = _pick_blocks(h, tq, tk, q_block, k_block)
     if not _use_pallas(tq, tk, bq, bk):
         def f(q, k, v):
-            out_ = _reference_attention(q, k, v, bias, scale, p_drop,
-                                        seed if p_drop > 0.0 else None,
-                                        causal=causal)
-            s = _reference_scores(q.astype(jnp.float32),
-                                  k.astype(jnp.float32), bias, scale,
-                                  causal)
-            lse_ = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
-            return out_, lse_
+            return _reference_attention_with_lse(
+                q, k, v, bias, scale, p_drop,
+                seed if p_drop > 0.0 else None, causal=causal)
 
         _, vjp = jax.vjp(f, q, k, v)
         return vjp((g, jnp.zeros((b, h, tq, 1), jnp.float32)
@@ -630,13 +630,8 @@ def _vjp_bwd(scale, p_drop, q_block, k_block, causal, res, g,
         glse = (jnp.zeros_like(lse) if g_lse is None else g_lse)
 
         def out_and_lse(a, b, c, bb):
-            out_ = _reference_attention(a, b, c, bb, scale, p_drop, sd,
-                                        causal)
-            s = _reference_scores(a.astype(jnp.float32),
-                                  b.astype(jnp.float32), bb, scale,
-                                  causal)
-            return out_, jax.scipy.special.logsumexp(
-                s, axis=-1, keepdims=True)
+            return _reference_attention_with_lse(a, b, c, bb, scale,
+                                                 p_drop, sd, causal)
 
         if bias is None:
             _, vjp = jax.vjp(

@@ -24,7 +24,7 @@ _NEG_INF = -1e30
 
 
 def _ring_attention_local(q, k, v, bias, *, axis_name: str, causal: bool,
-                          scale: float):
+                          scale: float, p_drop: float = 0.0, seed=None):
     """Per-shard body (runs inside shard_map).
 
     q: [b, h, tq_loc, dh]; k, v: [b, h, tk_loc, dh] (this rank's block);
@@ -50,13 +50,28 @@ def _ring_attention_local(q, k, v, bias, *, axis_name: str, causal: bool,
 
     from paddle_tpu.parallel import flash_attention as fa
 
-    def _block(k_blk, v_blk, blk_bias, blk_causal):
+    def _block(k_blk, v_blk, blk_bias, blk_causal, src):
         # the custom-vjp wrapper, NOT flash_attention_fwd: the sdpa grad
         # op differentiates ring_attention through jax.vjp, and a raw
         # pallas_call has no JVP rule on TPU — the wrapper routes the
-        # backward through the blocked kernels
+        # backward through the blocked kernels. Attention dropout works
+        # per block: the seed is mixed with the SOURCE rank so every
+        # ring step draws an independent mask stream (the kernel's own
+        # (b, jq, kk) keying is block-local and would repeat across
+        # steps), and forward/backward regenerate identically because
+        # the vjp re-derives the same per-step seed.
+        blk_seed = None
+        if p_drop > 0.0:
+            # mix BOTH the source block and the destination rank: the
+            # kernel's own (b, jq, kk) keying is block-local, so without
+            # the rank term every destination would regenerate identical
+            # masks for the same source block (dropout correlated across
+            # sequence shards instead of i.i.d.)
+            blk_seed = jnp.asarray(seed, jnp.int32)
+            for x in (src.astype(jnp.int32), rank.astype(jnp.int32)):
+                blk_seed = (blk_seed * jnp.int32(1000003)) ^ x
         o_blk, lse_blk = fa.flash_attention_with_lse(
-            q, k_blk, v_blk, blk_bias, None, scale, 0.0,
+            q, k_blk, v_blk, blk_bias, blk_seed, scale, p_drop,
             causal=blk_causal)
         return o_blk.astype(jnp.float32), lse_blk[..., 0]  # [b,h,tq]
 
@@ -70,15 +85,15 @@ def _ring_attention_local(q, k, v, bias, *, axis_name: str, causal: bool,
             blk_bias = jax.lax.dynamic_slice_in_dim(
                 bias, src * tk, tk, axis=3)
 
-        if causal:
-            # tq == tk along the ring (same sequence sharded once); the
+        if causal and tq == tk:
+            # same sequence sharded once: rank-level routing — the
             # diagonal needs the in-kernel mask, the past is dense, the
             # future is skipped outright (identity on the carry).
             def _past(_):
-                return _block(k_blk, v_blk, blk_bias, False)
+                return _block(k_blk, v_blk, blk_bias, False, src)
 
             def _diag(_):
-                return _block(k_blk, v_blk, blk_bias, True)
+                return _block(k_blk, v_blk, blk_bias, True, src)
 
             def _future(_):
                 return (jnp.zeros_like(o),
@@ -87,8 +102,19 @@ def _ring_attention_local(q, k, v, bias, *, axis_name: str, causal: bool,
             case = jnp.where(src < rank, 0, jnp.where(src == rank, 1, 2))
             o_blk, lse_blk = jax.lax.switch(
                 case, (_past, _diag, _future), operand=None)
+        elif causal:
+            # tq != tk: rank-level classification misaligns with true
+            # positions, so mask by GLOBAL positions as an additive bias
+            # into the kernel (correct for any chunking; no block skip)
+            q_pos = rank * tq + jnp.arange(tq)
+            k_pos = src * tk + jnp.arange(tk)
+            pos_bias = jnp.where(q_pos[:, None] >= k_pos[None, :],
+                                 0.0, _NEG_INF)[None, None]
+            eff_bias = (pos_bias if blk_bias is None
+                        else blk_bias.astype(jnp.float32) + pos_bias)
+            o_blk, lse_blk = _block(k_blk, v_blk, eff_bias, False, src)
         else:
-            o_blk, lse_blk = _block(k_blk, v_blk, blk_bias, False)
+            o_blk, lse_blk = _block(k_blk, v_blk, blk_bias, False, src)
 
         # logsumexp merge of two attention partials
         lse_new = jnp.logaddexp(lse, lse_blk)
@@ -125,11 +151,17 @@ def ring_attention(
     scale: Optional[float] = None,
     bias=None,
     data_axis: Optional[str] = None,
+    p_drop: float = 0.0,
+    seed=None,
 ):
     """Sequence-parallel attention: q, k, v are [b, h, t, dh] GLOBAL arrays
     (sharded or shardable over ``seq_axis`` on dim 2). ``bias`` is an
     optional additive [b, 1|h, tq, tk] mask (sharded over tq, global over
-    tk). ``data_axis`` additionally shards the batch dim."""
+    tk). ``data_axis`` additionally shards the batch dim. ``p_drop`` +
+    ``seed``: attention dropout, applied in-kernel per rotating block
+    with a source-rank-mixed seed stream."""
+    if p_drop > 0.0 and seed is None:
+        raise ValueError("ring_attention: p_drop > 0 requires `seed`")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     d = data_axis
@@ -156,6 +188,7 @@ def ring_attention(
         return _ring_attention_local(
             q, k, v, b if has_bias else None,
             axis_name=seq_axis, causal=causal, scale=scale,
+            p_drop=p_drop, seed=seed,
         )
 
     fn = jax.shard_map(
