@@ -90,11 +90,11 @@ def run_windows(exe, program, loss, feeds, steps=30, n_windows=3,
     if multi:
         windows = []
         for w in range(n_windows):
-            t0 = time.time()
+            t0 = time.perf_counter()
             out = exe.run_steps(program, feed_list=feeds, steps=steps,
                                 fetch_list=[loss])
             loss_v = float(np.asarray(out[0]))
-            elapsed = time.time() - t0
+            elapsed = time.perf_counter() - t0
             log(f"window {w}: {steps} steps in {elapsed:.2f}s, "
                 f"loss={loss_v:.3f}")
             windows.append(elapsed)
@@ -103,13 +103,13 @@ def run_windows(exe, program, loss, feeds, steps=30, n_windows=3,
         exe.run(program, feed=fd, fetch_list=[loss])
     windows = []
     for w in range(n_windows):
-        t0 = time.time()
+        t0 = time.perf_counter()
         out = None
         for i in range(steps):
             out = exe.run(program, feed=feeds[i % len(feeds)],
                           fetch_list=[loss], return_numpy=False)
         loss_v = float(np.asarray(out[0]))  # sync once per window
-        elapsed = time.time() - t0
+        elapsed = time.perf_counter() - t0
         log(f"window {w}: {steps} steps in {elapsed:.2f}s, loss={loss_v:.3f}")
         windows.append(elapsed)
     return min(windows), sum(windows) / len(windows)
@@ -127,9 +127,9 @@ def compile_with_oom_backoff(make_exe, run_first, batch, floor=8):
     while batch >= floor:
         try:
             exe = make_exe()
-            t0 = time.time()
+            t0 = time.perf_counter()
             run_first(exe, batch)
-            log(f"compile+first step: {time.time() - t0:.1f}s "
+            log(f"compile+first step: {time.perf_counter() - t0:.1f}s "
                 f"(batch={batch})")
             return exe, batch
         except Exception as e:

@@ -37,18 +37,18 @@ def run_config(label, dropout, vocab=10000, batch=32, seq=256, amp=True,
          T.make_batch(cfg, batch, seq, seq, seed=s).items()}
         for s in range(2)
     ]
-    t0 = time.time()
+    t0 = time.perf_counter()
     exe.run(main_prog, feed=feeds[0], fetch_list=[model["loss"]], scope=scope)
-    compile_s = time.time() - t0
+    compile_s = time.perf_counter() - t0
     for f in feeds:
         exe.run(main_prog, feed=f, fetch_list=[model["loss"]], scope=scope)
-    t0 = time.time()
+    t0 = time.perf_counter()
     out = None
     for i in range(steps):
         out = exe.run(main_prog, feed=feeds[i % 2], fetch_list=[model["loss"]],
                       scope=scope, return_numpy=False)
     _ = float(np.asarray(out[0]))
-    dt = (time.time() - t0) / steps
+    dt = (time.perf_counter() - t0) / steps
     print(f"{label:40s} step={dt*1000:7.1f}ms  compile={compile_s:6.1f}s",
           flush=True)
     return dt

@@ -139,20 +139,20 @@ def main():
 
     feeds = [{k: jax.device_put(v) for k, v in feed_fn(s).items()}
              for s in range(4)]
-    t_compile = time.time()
+    t_compile = time.perf_counter()
     exe.run(program, feed=feeds[0], fetch_list=[loss])
-    print(f"compile+first step: {time.time() - t_compile:.1f}s",
+    print(f"compile+first step: {time.perf_counter() - t_compile:.1f}s",
           file=sys.stderr)
 
     for i in range(args.skip_batch_num):
         exe.run(program, feed=feeds[i % 4], fetch_list=[loss])
-    t0 = time.time()
+    t0 = time.perf_counter()
     out = None
     for i in range(args.iterations):
         out = exe.run(program, feed=feeds[i % 4], fetch_list=[loss],
                       return_numpy=False)
     final_loss = float(np.asarray(out[0]))
-    elapsed = time.time() - t0
+    elapsed = time.perf_counter() - t0
     eps = examples * args.iterations / elapsed
     print(f"model={args.model} batch={args.batch_size} "
           f"iters={args.iterations} loss={final_loss:.4f}")

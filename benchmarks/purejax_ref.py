@@ -90,19 +90,19 @@ def main():
     t = jnp.zeros((), jnp.int32)
     ids = jnp.asarray(np.random.randint(0, V, (B, S)))
     y = jnp.asarray(np.random.randint(0, V, (B, S)))
-    t0 = time.time()
+    t0 = time.perf_counter()
     p, m, v, t, loss = step(p, m, v, t, ids, y, key)
     jax.block_until_ready(loss)
-    print(f"compile+1st: {time.time()-t0:.1f}s")
+    print(f"compile+1st: {time.perf_counter()-t0:.1f}s")
     for _ in range(3):
         p, m, v, t, loss = step(p, m, v, t, ids, y, key)
     jax.block_until_ready(loss)
     n = 20
-    t0 = time.time()
+    t0 = time.perf_counter()
     for _ in range(n):
         p, m, v, t, loss = step(p, m, v, t, ids, y, key)
     jax.block_until_ready(loss)
-    dt = (time.time() - t0) / n
+    dt = (time.perf_counter() - t0) / n
     flops = 3 * (2 * B * S * (L * 2) * (4 * D * D + 2 * D * DI) + 2 * B * S * D * V
                  + (L * 2) * 2 * 2 * B * S * S * D)
     print(f"step: {dt*1000:.1f}ms  ~MFU={flops/dt/197e12:.3f}")

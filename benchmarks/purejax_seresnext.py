@@ -137,17 +137,17 @@ def main():
     img = jnp.asarray(rng.randn(B, 224, 224, 3) * 0.5, jnp.bfloat16)
     label = jnp.asarray(rng.randint(0, 1000, (B,)), jnp.int32)
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     p, mom, loss = step(p, mom, img, label)
     jax.block_until_ready(loss)
-    print(f"compile+first: {time.time() - t0:.1f}s loss={float(loss):.3f}")
+    print(f"compile+first: {time.perf_counter() - t0:.1f}s loss={float(loss):.3f}")
 
     for w in range(3):
-        t0 = time.time()
+        t0 = time.perf_counter()
         for _ in range(30):
             p, mom, loss = step(p, mom, img, label)
         jax.block_until_ready(loss)
-        dt = (time.time() - t0) / 30
+        dt = (time.perf_counter() - t0) / 30
         fwd_flops = 8.47e9  # BASELINE.md analytic fwd GFLOP/image
         mfu = 3 * fwd_flops * B / dt / 197e12
         print(f"window {w}: {dt*1e3:.1f} ms/step  "

@@ -31,9 +31,12 @@ from paddle_tpu.compiler import (  # noqa: F401
 from paddle_tpu import (  # noqa: F401
     dataset_api,
     debugger,
+    flags,
     inference,
     install_check,
+    monitor,
     passes,
+    profiler,
     transpiler,
 )
 from paddle_tpu.dataset_api import DatasetFactory  # noqa: F401

@@ -17,10 +17,23 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from paddle_tpu import io as _io
+from paddle_tpu import monitor as _monitor
 from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu.executor import Executor, Scope, scope_guard
 from paddle_tpu.framework import Program, program_guard
 from paddle_tpu.parallel import checkpoint as _ckpt
+
+# Epoch/step events feed the metrics plane (previously display-only via
+# the user's event_handler); spans put them on the same chrome-trace
+# timeline as executor compile/run spans.
+_M_EPOCHS = _monitor.counter(
+    "pt_trainer_epochs_total", "completed training epochs")
+_M_TRAIN_STEPS = _monitor.counter(
+    "pt_trainer_steps_total", "trainer steps run")
+_M_CKPTS = _monitor.counter(
+    "pt_trainer_checkpoints_total", "checkpoints saved")
+_M_LOSS = _monitor.gauge(
+    "pt_trainer_last_loss", "loss fetched at the most recent step")
 
 
 _RNG_STEP_KEY = "__trainer_rng_step__"
@@ -188,27 +201,38 @@ class Trainer:
                 if self._stopped:
                     break
                 handler(BeginEpochEvent(epoch))
-                for step, batch in enumerate(reader()):
-                    if self._stopped:
-                        break
-                    handler(BeginStepEvent(epoch, step))
-                    metrics = self.exe.run(
-                        self._run_program,
-                        feed=feeder.feed(batch),
-                        fetch_list=fetch,
-                    )
-                    handler(EndStepEvent(epoch, step, metrics))
+                with _monitor.span("trainer.epoch"):
+                    for step, batch in enumerate(reader()):
+                        if self._stopped:
+                            break
+                        handler(BeginStepEvent(epoch, step))
+                        with _monitor.span("trainer.step"):
+                            metrics = self.exe.run(
+                                self._run_program,
+                                feed=feeder.feed(batch),
+                                fetch_list=fetch,
+                            )
+                        if _monitor.enabled():
+                            _M_TRAIN_STEPS.inc()
+                            if metrics:
+                                v = np.asarray(metrics[0])
+                                if v.size:
+                                    _M_LOSS.set(float(v.ravel()[0]))
+                        handler(EndStepEvent(epoch, step, metrics))
                 if self._stopped:
                     # stopped mid-epoch: the epoch did NOT complete — no
                     # EndEpochEvent and no checkpoint, or resume would
                     # silently skip the untrained remainder of it.
                     break
                 handler(EndEpochEvent(epoch))
+                _M_EPOCHS.inc()
                 if (
                     self._ckpt_cfg is not None
                     and (epoch + 1) % self._ckpt_cfg.epoch_interval == 0
                 ):
-                    self._save_checkpoint(epoch + 1)
+                    with _monitor.span("trainer.checkpoint"):
+                        self._save_checkpoint(epoch + 1)
+                    _M_CKPTS.inc()
 
     def test(self, reader, feed_order: Sequence[str]):
         feeder = DataFeeder(

@@ -23,8 +23,19 @@ from typing import Any, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu import monitor as _monitor
 from paddle_tpu.core import autodiff
 from paddle_tpu.core.registry import GRAD_OP_SUFFIX, OpDef, get_op_def, has_op
+
+# Ops lowered into XLA programs. exec_ops runs at TRACE time (cached
+# compiled steps never re-enter Python), so these count per COMPILE —
+# a growing rate mid-training means recompiles, the classic silent
+# step-time killer this telemetry exists to surface.
+_M_OPS_LOWERED = _monitor.counter(
+    "pt_ops_lowered_total", "ops traced into XLA programs (per compile)")
+_M_BLOCKS_TRACED = _monitor.counter(
+    "pt_blocks_traced_total",
+    "op-list traces (top-level blocks + control-flow sub-blocks)")
 
 # MXU-heavy ops that run in bfloat16 under AMP: every f32 input (master
 # weights included) is cast to bf16 and the output STAYS bf16, so the whole
@@ -209,6 +220,9 @@ def exec_ops(
         amp = amp_active()
     if op_defs is None:
         op_defs = [resolve_op_def(op.type) for op in ops]
+    if _monitor.enabled():
+        _M_BLOCKS_TRACED.inc()
+        _M_OPS_LOWERED.inc(len(ops))
     for idx, (op, opdef) in enumerate(zip(ops, op_defs)):
         ins = {
             slot: [env[n] if n else None for n in names]

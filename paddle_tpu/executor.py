@@ -11,6 +11,7 @@ prepared-context cache (reference: executor.py:704).
 from __future__ import annotations
 
 import contextlib
+import time
 
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -18,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu import monitor as _monitor
 from paddle_tpu.core import lowering
 from paddle_tpu.framework import (
     CPUPlace,
@@ -26,6 +28,51 @@ from paddle_tpu.framework import (
     Variable,
     default_main_program,
 )
+
+# Telemetry instruments (no-ops while the 'telemetry' flag is off — one
+# boolean check per call, zero allocations; see monitor.py).
+_M_CACHE_HITS = _monitor.counter(
+    "pt_executor_cache_hits_total", "compiled-step cache hits")
+_M_CACHE_MISSES = _monitor.counter(
+    "pt_executor_cache_misses_total",
+    "compiled-step cache misses (fresh compiles)")
+_M_CACHE_EVICTIONS = _monitor.counter(
+    "pt_executor_cache_evictions_total",
+    "compiled-step cache entries evicted at capacity")
+_M_DONATED_DROPS = _monitor.counter(
+    "pt_executor_donated_drops_total",
+    "donated state buffers dropped after a failed step")
+_M_STEPS = _monitor.counter(
+    "pt_executor_steps_total",
+    "executor steps run (run_steps windows count each inner step)")
+_M_FEED_BYTES = _monitor.counter(
+    "pt_executor_feed_bytes_total",
+    "bytes across feed arrays per step (an upper bound on host->device "
+    "transfer: device-resident or staging-cached feeds count too)")
+_M_FETCH_BYTES = _monitor.counter(
+    "pt_executor_fetch_bytes_total", "bytes across fetch arrays per step")
+_M_NAN_FAILS = _monitor.counter(
+    "pt_executor_nan_check_failures_total",
+    "check_nan_inf scans that found non-finite values")
+
+
+def _sum_nbytes(vals) -> int:
+    total = 0
+    for v in vals:
+        n = getattr(v, "nbytes", None)
+        if n is not None:
+            total += int(n)
+    return total
+
+
+def _strategy_id(strategy) -> Optional[str]:
+    """Compact SPMD strategy label for step logs: mesh axes x sizes."""
+    if strategy is None:
+        return None
+    mesh = getattr(strategy, "mesh", None)
+    if mesh is None:
+        return "strategy"
+    return ",".join(f"{a}={mesh.shape[a]}" for a in mesh.axis_names)
 
 
 class Scope:
@@ -119,6 +166,9 @@ class Executor:
     ):
         from paddle_tpu.compiler import CompiledProgram
 
+        tele = _monitor.enabled()
+        # wall_ms covers the WHOLE call, feed conversion/staging included
+        t_run0 = time.perf_counter() if tele else 0.0
         compiled = None
         if isinstance(program, CompiledProgram):
             compiled = program
@@ -151,18 +201,17 @@ class Executor:
             tuple(fetch_names),
             scope._uid,
         )
-        from paddle_tpu import profiler as _profiler
-
         def build():
-            with _profiler.record_event("executor.compile"):
-                return self._compile(
-                    program, compiled, feed_names, fetch_names, scope
-                )
+            return self._compile(
+                program, compiled, feed_names, fetch_names, scope
+            )
 
         if use_program_cache:
-            entry = self._cache_entry(key, build)
+            entry, cache_hit, evictions, compile_ms = self._cache_entry(
+                key, build)
         else:
-            entry = build()
+            entry, compile_ms = self._timed_build(build)
+            cache_hit, evictions = False, 0
         fn, lowered = entry
 
         state = self._gather_state(scope, lowered)
@@ -184,16 +233,41 @@ class Executor:
         from paddle_tpu.core import interp as _interp
 
         strategy = compiled._strategy if compiled is not None else None
-        with _interp.spmd_ctx_scope(strategy), \
-                _profiler.record_event("executor.run_step"):
-            try:
-                fetches, new_state = fn(state, feed_vals, base_key,
-                                        np.uint32(step_idx))
-            except Exception:
-                self._drop_donated(scope, lowered)
-                raise
-        return self._commit(scope, fetch_names, fetches, new_state,
-                            return_numpy)
+        rec = None
+        if tele:
+            _M_STEPS.inc()
+            feed_bytes = _sum_nbytes(feed_vals.values())
+            _M_FEED_BYTES.inc(feed_bytes)
+            if _monitor.step_log_active():
+                rec = {
+                    "kind": "step",
+                    "step": step_idx,
+                    "compile_ms": compile_ms,
+                    "cache": "hit" if cache_hit else "miss",
+                    "evictions": evictions,
+                    "feed_bytes": feed_bytes,
+                    "fetch_bytes": 0,
+                    "nan_check": None,
+                    "strategy": _strategy_id(strategy),
+                }
+        try:
+            with _interp.spmd_ctx_scope(strategy), \
+                    _monitor.span("executor.run_step"):
+                try:
+                    fetches, new_state = fn(state, feed_vals, base_key,
+                                            np.uint32(step_idx))
+                except Exception:
+                    self._drop_donated(scope, lowered)
+                    raise
+            return self._commit(scope, fetch_names, fetches, new_state,
+                                return_numpy, rec)
+        finally:
+            # logged even when the step raises (NaN scan, device/runtime
+            # error): the crashed step's record is the one an operator
+            # needs for postmortem, and must be the last line of the log
+            if rec is not None:
+                rec["wall_ms"] = (time.perf_counter() - t_run0) * 1e3
+                _monitor.log_step(rec)
 
     def run_steps(
         self,
@@ -222,6 +296,10 @@ class Executor:
                 "inputs/SPMD context are per-step concerns); use run()")
         if not feed_list:
             raise ValueError("run_steps needs a non-empty feed_list")
+        tele = _monitor.enabled()
+        # started before feed stacking: device_put of the whole window is
+        # often the dominant host cost, and wall_ms must show it
+        t_run0 = time.perf_counter() if tele else 0.0
         if program is None:
             program = default_main_program()
         scope = scope or global_scope()
@@ -281,40 +359,86 @@ class Executor:
             return (lowering.jit_lowered_multi(lowered, len(feed_list)),
                     lowered)
 
-        fn, lowered = self._cache_entry(key, build)
+        entry, cache_hit, evictions, compile_ms = self._cache_entry(
+            key, build)
+        fn, lowered = entry
         state = self._gather_state(scope, lowered)
         base_key = self._base_key_for(program)
         start = self._step
         self._step += int(steps)
-        try:
-            fetches, new_state = fn(state, stacked, base_key,
-                                    np.uint32(start), int(steps))
-        except Exception:
-            self._drop_donated(scope, lowered)
-            raise
+        rec = None
+        if tele:
+            _M_STEPS.inc(int(steps))
+            feed_bytes = _sum_nbytes(stacked.values())
+            _M_FEED_BYTES.inc(feed_bytes)
+            if _monitor.step_log_active():
+                rec = {
+                    "kind": "window",
+                    "step": start,
+                    "steps": int(steps),
+                    "compile_ms": compile_ms,
+                    "cache": "hit" if cache_hit else "miss",
+                    "evictions": evictions,
+                    "feed_bytes": feed_bytes,
+                    "fetch_bytes": 0,
+                    "nan_check": None,
+                    "strategy": None,
+                }
         # note: under check_nan_inf the scan here is window-level (last
         # fetch + final state), not per-step — per-step scans would
         # defeat the whole point of the compiled loop
-        return self._commit(scope, fetch_names, fetches, new_state,
-                            return_numpy)
+        try:
+            with _monitor.span("executor.run_window"):
+                try:
+                    fetches, new_state = fn(state, stacked, base_key,
+                                            np.uint32(start), int(steps))
+                except Exception:
+                    self._drop_donated(scope, lowered)
+                    raise
+            return self._commit(scope, fetch_names, fetches, new_state,
+                                return_numpy, rec)
+        finally:
+            # logged even when the window raises (see run())
+            if rec is not None:
+                rec["wall_ms"] = (time.perf_counter() - t_run0) * 1e3
+                _monitor.log_step(rec)
 
     # --- shared plumbing for run()/run_steps() ---
 
     def _cache_entry(self, key, build):
-        """LRU lookup-or-build with the capacity eviction policy."""
+        """LRU lookup-or-build with the capacity eviction policy.
+
+        Returns ``(entry, hit, evictions, compile_ms)`` — the cache
+        outcome rides the return value (not instance state) so the
+        step-log assembly can never read a stale previous call's
+        outcome."""
         entry = self._cache.get(key)
         if entry is not None:
             self._cache.pop(key)
             self._cache[key] = entry  # refresh so eviction drops coldest
-            return entry
-        entry = build()
+            _M_CACHE_HITS.inc()
+            return entry, True, 0, None
+        _M_CACHE_MISSES.inc()
+        entry, compile_ms = self._timed_build(build)
         self._cache[key] = entry
         from paddle_tpu import flags as _flags_mod
 
         cap = _flags_mod.get_flag("executor_cache_capacity")
+        evicted = 0
         while cap > 0 and len(self._cache) > cap:
             self._cache.pop(next(iter(self._cache)))
-        return entry
+            evicted += 1
+        if evicted:
+            _M_CACHE_EVICTIONS.inc(evicted)
+        return entry, False, evicted, compile_ms
+
+    def _timed_build(self, build):
+        """Compile under the unified span; returns ``(entry,
+        compile_ms)`` (perf_counter interval) for the step log."""
+        with _monitor.span("executor.compile"):
+            t0 = time.perf_counter()
+            entry = build()
+            return entry, (time.perf_counter() - t0) * 1e3
 
     def _gather_state(self, scope, lowered):
         state = {}
@@ -344,9 +468,10 @@ class Executor:
             v = scope.find_var(n)
             if isinstance(v, jax.Array) and v.is_deleted():
                 scope.drop(n)
+                _M_DONATED_DROPS.inc()
 
     def _commit(self, scope, fetch_names, fetches, new_state,
-                return_numpy):
+                return_numpy, rec=None):
         from paddle_tpu import flags as _flags
 
         if _flags.get_flag("benchmark"):
@@ -357,8 +482,21 @@ class Executor:
         # buffers were donated and already deleted.
         for n, v in new_state.items():
             scope.set(n, v)
+        if rec is not None:
+            rec["fetch_bytes"] = _sum_nbytes(fetches)
+            _M_FETCH_BYTES.inc(rec["fetch_bytes"])
+        elif _monitor.enabled():
+            _M_FETCH_BYTES.inc(_sum_nbytes(fetches))
         if _flags.get_flag("check_nan_inf"):
-            self._check_nan_inf(fetch_names, fetches, new_state)
+            try:
+                self._check_nan_inf(fetch_names, fetches, new_state)
+            except FloatingPointError:
+                _M_NAN_FAILS.inc()
+                if rec is not None:
+                    rec["nan_check"] = "fail"
+                raise
+            if rec is not None:
+                rec["nan_check"] = "ok"
         if return_numpy:
             fetches = [np.asarray(x) for x in fetches]
         return fetches

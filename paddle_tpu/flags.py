@@ -31,9 +31,24 @@ _DEFS: Dict[str, tuple] = {
     # only after a peer's multi-minute first compile. Pass timeout_ms=-1
     # to a specific call for block-forever.
     "rpc_deadline_ms": (int, 600_000, "coord/KV operation deadline"),
+    # runtime telemetry plane (monitor.py): metrics registry + structured
+    # step logs + span histograms. Off by default — with it off every
+    # instrument call is one boolean check.
+    "telemetry": (bool, False, "enable the monitor.py telemetry plane"),
+    # one JSONL record per Executor.run / run_steps call (monitor.py
+    # STEP_LOG_FIELDS schema); empty = no step log even with telemetry on
+    "step_log_path": (str, "", "JSONL step-log file path"),
+    # monitor.dump_metrics() target; also dumped at process exit while
+    # telemetry is on
+    "metrics_dump_path": (str, "", "metrics export file path"),
 }
 
 _values: Dict[str, Any] = {}
+
+# name -> [callbacks]; notified on every set_flags change to that flag
+# (and once on registration) so modules can cache hot flag values instead
+# of doing a dict lookup per call — monitor.py's enabled() fast path.
+_watchers: Dict[str, list] = {}
 
 
 def _parse(ty, raw: str):
@@ -68,6 +83,33 @@ def set_flags(flags: Dict[str, Any]):
             raise KeyError(f"unknown flag '{name}'; known: {sorted(_DEFS)}")
         ty = _DEFS[name][0]
         _values[name] = _parse(ty, v) if isinstance(v, str) else ty(v)
+        for cb in _watchers.get(name, ()):
+            cb(_values[name])
+
+
+def watch_flag(name: str, callback):
+    """Call ``callback(value)`` now and on every subsequent change to
+    ``name`` via set_flags — the cached-hot-flag pattern (monitor.py)."""
+    if name not in _DEFS:
+        raise KeyError(f"unknown flag '{name}'; known: {sorted(_DEFS)}")
+    _watchers.setdefault(name, []).append(callback)
+    callback(_values[name])
+
+
+def describe_flags() -> list:
+    """Self-documenting flag table: one dict per registered flag with
+    ``name``/``type``/``default``/``doc``/``value`` (current), sorted by
+    name — so flag docs are reachable without reading this source."""
+    return [
+        {
+            "name": name,
+            "type": ty.__name__,
+            "default": default,
+            "doc": doc,
+            "value": _values[name],
+        }
+        for name, (ty, default, doc) in sorted(_DEFS.items())
+    ]
 
 
 _bootstrap()

@@ -23,14 +23,38 @@ data parallelism; per-step liveness goes through heartbeat/dead_workers
 from __future__ import annotations
 
 import atexit
+import re as _re
+import time as _time
 from typing import Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu import monitor as _monitor
 from paddle_tpu.incubate.fleet.role_maker import (
     EnvRoleMaker,
     RoleMakerBase,
 )
+
+# Barrier waits are THE multi-host stall signal (a slow rank shows up as
+# everyone else's barrier time); rendezvous counts > 1 mean the job
+# re-formed its world (failure recovery re-rendezvous).
+_M_BARRIER_WAIT = _monitor.histogram(
+    "pt_fleet_barrier_wait_seconds",
+    "time spent waiting in fleet barriers, by barrier name")
+_M_RENDEZVOUS = _monitor.counter(
+    "pt_fleet_rendezvous_total",
+    "successful multi-worker rendezvous (>1 per process = recovery)")
+_M_DEAD_EVENTS = _monitor.counter(
+    "pt_fleet_dead_worker_events_total",
+    "barrier_or_dead returns that reported dead peers")
+
+
+def _barrier_label(name: str) -> str:
+    """Bounded label cardinality: callers bake step/generation numbers
+    into barrier names (e.g. 'step3-g1' in the recovery protocol), and a
+    fresh histogram cell per training step would grow the registry and
+    the Prometheus export without bound. Digit runs collapse to '*'."""
+    return _re.sub(r"\d+", "*", name)
 
 
 class Fleet:
@@ -68,27 +92,33 @@ class Fleet:
                 )
             host, port = endpoint.rsplit(":", 1)
             port = int(port)
-            if self._role.is_first_worker():
-                self._server = native.CoordServer(port)
-            # workers retry-connect until rank 0's server is up
-            self._client = _connect_retry(host, port, connect_timeout_ms)
+            with _monitor.span("fleet.rendezvous"):
+                if self._role.is_first_worker():
+                    self._server = native.CoordServer(port)
+                # workers retry-connect until rank 0's server is up
+                self._client = _connect_retry(host, port,
+                                              connect_timeout_ms)
 
-            jax_ep = self._role.jax_coord_endpoint() or f"{host}:{port + 1}"
-            if self._role.is_first_worker():
-                self._client.put("fleet/jax_coordinator", jax_ep.encode())
-            else:
-                jax_ep = self._client.get(
-                    "fleet/jax_coordinator", timeout_ms=connect_timeout_ms
-                ).decode()
-            self._client.barrier("fleet/rendezvous", n)
+                jax_ep = (self._role.jax_coord_endpoint()
+                          or f"{host}:{port + 1}")
+                if self._role.is_first_worker():
+                    self._client.put("fleet/jax_coordinator",
+                                     jax_ep.encode())
+                else:
+                    jax_ep = self._client.get(
+                        "fleet/jax_coordinator",
+                        timeout_ms=connect_timeout_ms,
+                    ).decode()
+                self._client.barrier("fleet/rendezvous", n)
 
-            import jax
+                import jax
 
-            jax.distributed.initialize(
-                jax_ep,
-                num_processes=n,
-                process_id=self._role.worker_index(),
-            )
+                jax.distributed.initialize(
+                    jax_ep,
+                    num_processes=n,
+                    process_id=self._role.worker_index(),
+                )
+            _M_RENDEZVOUS.inc()
             atexit.register(self.stop_worker)
         self._initialized = True
         return self
@@ -121,7 +151,14 @@ class Fleet:
 
     def barrier(self, name: str = "fleet/barrier"):
         if self._client is not None:
-            self._client.barrier(name, self.worker_num())
+            # span and observe are both self-gating: with only the
+            # profiler on this still lands in the chrome trace, with
+            # only telemetry on it still feeds the histogram
+            t0 = _time.perf_counter()
+            with _monitor.span("fleet.barrier"):
+                self._client.barrier(name, self.worker_num())
+            _M_BARRIER_WAIT.observe(_time.perf_counter() - t0,
+                                    labels={"barrier": _barrier_label(name)})
 
     def put(self, key: str, value: bytes):
         if self._client is None:
@@ -168,8 +205,7 @@ class Fleet:
         rendezvous (new coord world), as the recovery protocol does."""
         if self._client is None:
             return []
-        import time as _time
-
+        t_wait0 = _time.perf_counter()
         me = self.worker_index()
         # Epoch-keyed arrivals: every call gets this client's barrier
         # SEQUENCE NUMBER in the key. All workers reach their N-th
@@ -209,14 +245,23 @@ class Fleet:
                     missing.append(r)
             if not missing:
                 self._done_barriers.append(tag)
+                _M_BARRIER_WAIT.observe(_time.perf_counter() - t_wait0,
+                                        labels={"barrier": _barrier_label(name)})
                 return []
             dead = list(self._client.dead_peers(max_age_ms))
             dead_missing = [d for d in dead
                             if any(d == f"worker-{r}" for r in missing)]
             if dead_missing:
                 self._done_barriers = []
+                _M_DEAD_EVENTS.inc()
+                _M_BARRIER_WAIT.observe(_time.perf_counter() - t_wait0,
+                                        labels={"barrier": _barrier_label(name)})
                 return dead_missing
             if _time.monotonic() > deadline:
+                # the timeout IS the pathological wait this histogram
+                # exists to surface — record it before raising
+                _M_BARRIER_WAIT.observe(_time.perf_counter() - t_wait0,
+                                        labels={"barrier": _barrier_label(name)})
                 raise TimeoutError(
                     f"barrier_or_dead {name!r}: workers {missing} neither "
                     f"arrived nor declared dead within {timeout_ms} ms")

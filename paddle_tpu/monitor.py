@@ -1,0 +1,518 @@
+"""Runtime telemetry plane: metrics registry, structured step logs, spans.
+
+The reference framework shipped a real observability stack (RecordEvent
+host spans + CUPTI DeviceTracer + tools/timeline.py chrome traces); this
+module is its runtime-metrics half, grown past the reference: one
+process-wide plane with three pillars.
+
+1. **Metrics registry** — ``counter()``/``gauge()``/``histogram()`` return
+   process-wide named instruments with optional labels. Every mutation
+   checks one module-level boolean first, so with telemetry off (the
+   default) a call costs a flag check and allocates nothing — hot paths
+   (``Executor.run``) stay instrumented permanently. ``snapshot()``
+   returns plain dicts; ``dump_metrics()`` exports Prometheus text or
+   JSON.
+
+2. **Structured step logs** — ``log_step(record)`` appends one JSONL
+   record per executor step to the ``step_log_path`` flag's file. The
+   schema is versioned (``STEP_LOG_SCHEMA_VERSION``) and documented
+   field-by-field in ``STEP_LOG_FIELDS`` (also README "Observability").
+
+3. **Span unification** — ``span(name)`` wraps
+   ``profiler.record_event`` so host spans from the executor, trainer
+   epoch/step events, fleet barrier waits, ring-attention rotations and
+   pipeline schedules all land in ONE chrome-trace timeline under
+   consistent dotted names; with telemetry on, every span additionally
+   feeds the ``pt_span_seconds`` histogram (interval measured with
+   ``time.perf_counter`` — wall clock is only ever used for
+   human-readable timestamps).
+
+Everything is off by default behind the typed flags ``telemetry``,
+``step_log_path`` and ``metrics_dump_path`` (flags.py); flipping
+``telemetry`` at runtime takes effect immediately via a flag watcher.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import io
+import json
+import threading
+import time
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+from paddle_tpu import flags as _flags
+from paddle_tpu import profiler as _profiler
+
+# ---------------------------------------------------------------------------
+# enable/disable plumbing
+# ---------------------------------------------------------------------------
+
+# THE fast-path flag: every instrument mutation reads this one module-level
+# boolean and returns before touching any other state when it is False.
+_enabled = False
+
+_LOCK = threading.Lock()
+
+# The step-log writer gets its OWN lock: log_step does disk I/O (write +
+# flush per record) and must never stall metric mutations under _LOCK.
+_STEP_LOG_LOCK = threading.Lock()
+
+# step-log writer state (lazily opened; keyed by path so a flag change
+# mid-process rotates to the new file)
+_step_log_file: Optional[io.TextIOBase] = None
+_step_log_path: str = ""
+_step_seq = 0
+
+
+def enabled() -> bool:
+    """Whether telemetry is on (cached value of the ``telemetry`` flag)."""
+    return _enabled
+
+
+def _sync_from_flags(_value=None):
+    global _enabled
+    _enabled = bool(_flags.get_flag("telemetry"))
+
+
+def enable(step_log_path: Optional[str] = None,
+           metrics_dump_path: Optional[str] = None):
+    """Convenience: flip the ``telemetry`` flag (and optionally the log /
+    dump path flags) on. Equivalent to ``flags.set_flags({...})``."""
+    new = {"telemetry": True}
+    if step_log_path is not None:
+        new["step_log_path"] = step_log_path
+    if metrics_dump_path is not None:
+        new["metrics_dump_path"] = metrics_dump_path
+    _flags.set_flags(new)
+
+
+def disable():
+    _flags.set_flags({"telemetry": False})
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+# label values are keyed by a sorted (k, v) tuple; () is the unlabelled cell
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[Dict[str, Any]]) -> _LabelKey:
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is a no-op (one flag check, zero
+    allocations) while telemetry is off."""
+
+    kind = "counter"
+    __slots__ = ("name", "doc", "_cells")
+
+    def __init__(self, name: str, doc: str):
+        self.name = name
+        self.doc = doc
+        self._cells: Dict[_LabelKey, float] = {}
+
+    def inc(self, n: float = 1, labels: Optional[Dict[str, Any]] = None):
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        with _LOCK:
+            self._cells[key] = self._cells.get(key, 0.0) + n
+
+    def value(self, labels: Optional[Dict[str, Any]] = None) -> float:
+        return self._cells.get(_label_key(labels), 0.0)
+
+
+class Gauge:
+    """Last-value instrument (``set``) with an ``add`` for +/- deltas."""
+
+    kind = "gauge"
+    __slots__ = ("name", "doc", "_cells")
+
+    def __init__(self, name: str, doc: str):
+        self.name = name
+        self.doc = doc
+        self._cells: Dict[_LabelKey, float] = {}
+
+    def set(self, v: float, labels: Optional[Dict[str, Any]] = None):
+        if not _enabled:
+            return
+        with _LOCK:
+            self._cells[_label_key(labels)] = float(v)
+
+    def add(self, n: float = 1, labels: Optional[Dict[str, Any]] = None):
+        if not _enabled:
+            return
+        key = _label_key(labels)
+        with _LOCK:
+            self._cells[key] = self._cells.get(key, 0.0) + n
+
+    def value(self, labels: Optional[Dict[str, Any]] = None) -> float:
+        return self._cells.get(_label_key(labels), 0.0)
+
+
+# default buckets: tuned for step/compile/barrier latencies in seconds
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each bucket
+    counts observations <= its upper bound; +Inf is implicit)."""
+
+    kind = "histogram"
+    __slots__ = ("name", "doc", "buckets", "_cells")
+
+    def __init__(self, name: str, doc: str,
+                 buckets: Iterable[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.doc = doc
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        # cell: [counts per bucket..., +inf count, sum]
+        self._cells: Dict[_LabelKey, list] = {}
+
+    def observe(self, v: float, labels: Optional[Dict[str, Any]] = None):
+        if not _enabled:
+            return
+        v = float(v)
+        key = _label_key(labels)
+        with _LOCK:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = [0] * (len(self.buckets) + 1) + [0.0]
+                self._cells[key] = cell
+            for i, ub in enumerate(self.buckets):
+                if v <= ub:
+                    cell[i] += 1
+                    break
+            else:
+                cell[len(self.buckets)] += 1
+            cell[-1] += v
+
+    def count(self, labels: Optional[Dict[str, Any]] = None) -> int:
+        cell = self._cells.get(_label_key(labels))
+        return int(sum(cell[:-1])) if cell else 0
+
+    def sum(self, labels: Optional[Dict[str, Any]] = None) -> float:
+        cell = self._cells.get(_label_key(labels))
+        return float(cell[-1]) if cell else 0.0
+
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def _get_or_create(cls, name: str, doc: str, **kwargs):
+    with _LOCK:
+        m = _REGISTRY.get(name)
+        if m is not None:
+            if not isinstance(m, cls):
+                raise TypeError(
+                    f"metric '{name}' already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+        m = cls(name, doc, **kwargs)
+        _REGISTRY[name] = m
+        return m
+
+
+def counter(name: str, doc: str = "") -> Counter:
+    return _get_or_create(Counter, name, doc)
+
+
+def gauge(name: str, doc: str = "") -> Gauge:
+    return _get_or_create(Gauge, name, doc)
+
+
+def histogram(name: str, doc: str = "",
+              buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+    h = _get_or_create(Histogram, name, doc, buckets=buckets)
+    want = tuple(sorted(float(b) for b in buckets))
+    if h.buckets != want:
+        # silently returning the existing instrument would bucket the
+        # caller's observations against bounds it never asked for
+        raise ValueError(
+            f"histogram '{name}' already registered with buckets "
+            f"{h.buckets}, requested {want}")
+    return h
+
+
+def reset():
+    """Zero every registered metric and close the step-log writer (test
+    isolation). Metric OBJECTS survive — instrumented modules hold
+    references to them, so dropping the registry would orphan live
+    instruments into invisible counters."""
+    global _step_log_file, _step_log_path, _step_seq, _step_log_warned
+    with _LOCK:
+        for m in _REGISTRY.values():
+            m._cells.clear()
+    with _STEP_LOG_LOCK:
+        _step_log_warned = False
+        if _step_log_file is not None:
+            try:
+                _step_log_file.close()
+            except OSError:
+                pass
+        _step_log_file = None
+        _step_log_path = ""
+        _step_seq = 0
+
+
+def snapshot() -> Dict[str, Any]:
+    """Plain-dict view of every registered metric.
+
+    ``{name: {"kind", "doc", "values": [{"labels": {...}, ...}]}}`` —
+    counters/gauges carry ``value``; histograms carry ``count``, ``sum``
+    and cumulative ``buckets`` ``[[upper_bound, count], ...]`` ending in
+    the +Inf bucket.
+    """
+    out: Dict[str, Any] = {}
+    with _LOCK:
+        for name, m in sorted(_REGISTRY.items()):
+            values = []
+            for key, cell in sorted(m._cells.items()):
+                labels = {k: v for k, v in key}
+                if m.kind == "histogram":
+                    cum, acc = [], 0
+                    for ub, c in zip(m.buckets, cell):
+                        acc += c
+                        cum.append([ub, acc])
+                    acc += cell[len(m.buckets)]
+                    cum.append(["+Inf", acc])
+                    values.append({"labels": labels, "count": acc,
+                                   "sum": cell[-1], "buckets": cum})
+                else:
+                    values.append({"labels": labels, "value": cell})
+            out[name] = {"kind": m.kind, "doc": m.doc, "values": values}
+    return out
+
+
+# --- exporters ---
+
+def _prom_labels(labels: Dict[str, str], extra: Optional[tuple] = None) -> str:
+    items = list(labels.items())
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (k, str(v).replace("\\", "\\\\")
+                     .replace('"', '\\"').replace("\n", "\\n"))
+        for k, v in items
+    )
+    return "{%s}" % body
+
+
+def to_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
+    """Prometheus text exposition format (# HELP / # TYPE / samples)."""
+    snap = snapshot() if snap is None else snap
+    lines = []
+    for name, m in snap.items():
+        if m["doc"]:
+            lines.append(f"# HELP {name} {m['doc']}")
+        lines.append(f"# TYPE {name} {m['kind']}")
+        for cell in m["values"]:
+            labels = cell["labels"]
+            if m["kind"] == "histogram":
+                for ub, c in cell["buckets"]:
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_prom_labels(labels, ('le', ub))} {c}")
+                lines.append(
+                    f"{name}_sum{_prom_labels(labels)} {cell['sum']}")
+                lines.append(
+                    f"{name}_count{_prom_labels(labels)} {cell['count']}")
+            else:
+                lines.append(
+                    f"{name}{_prom_labels(labels)} {cell['value']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def to_json(snap: Optional[Dict[str, Any]] = None) -> str:
+    return json.dumps(snapshot() if snap is None else snap,
+                      sort_keys=True, indent=1)
+
+
+def dump_metrics(path: Optional[str] = None, fmt: str = "prometheus") -> str:
+    """Export all metrics; returns the text, writes it to ``path`` (or the
+    ``metrics_dump_path`` flag when set) too. ``fmt``: 'prometheus' or
+    'json'."""
+    if fmt in ("prometheus", "prom", "text"):
+        text = to_prometheus()
+    elif fmt == "json":
+        text = to_json()
+    else:
+        raise ValueError(f"unknown metrics format '{fmt}'")
+    path = path or _flags.get_flag("metrics_dump_path")
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def _dump_at_exit():
+    if _enabled and _flags.get_flag("metrics_dump_path"):
+        try:
+            dump_metrics()
+        except OSError:
+            pass
+
+
+atexit.register(_dump_at_exit)
+
+
+# ---------------------------------------------------------------------------
+# structured step logs
+# ---------------------------------------------------------------------------
+
+STEP_LOG_SCHEMA_VERSION = 1
+
+# field name -> (accepted types, required, doc). The contract tests and
+# README both derive from this table; bump STEP_LOG_SCHEMA_VERSION on any
+# incompatible change.
+STEP_LOG_FIELDS: Dict[str, tuple] = {
+    "v": ((int,), True, "schema version (STEP_LOG_SCHEMA_VERSION)"),
+    "ts": ((float, int), True,
+           "wall-clock unix timestamp (human-readable anchor only; all "
+           "durations are perf_counter intervals)"),
+    "seq": ((int,), True, "process-wide record sequence number"),
+    "kind": ((str,), True, "'step' (Executor.run) or 'window' (run_steps)"),
+    "step": ((int,), True, "executor step index (first step of a window)"),
+    "steps": ((int,), False, "window length (kind == 'window' only)"),
+    "wall_ms": ((float, int), True,
+                "host wall time of the run call, perf_counter-based"),
+    "compile_ms": ((float, int, type(None)), True,
+                   "XLA lower+jit wrap time; null on a cache hit"),
+    "cache": ((str,), True, "compile-cache outcome: 'hit' or 'miss'"),
+    "evictions": ((int,), True,
+                  "cache entries evicted by this step's insert"),
+    "feed_bytes": ((int,), True, "total bytes across feed arrays"),
+    "fetch_bytes": ((int,), True, "total bytes across fetch arrays"),
+    "nan_check": ((str, type(None)), True,
+                  "'ok'/'fail' when check_nan_inf ran, else null"),
+    "strategy": ((str, type(None)), True,
+                 "SPMD strategy id (mesh axes) or null for plain runs"),
+}
+
+
+def validate_step_record(rec: Dict[str, Any]):
+    """Raise ValueError unless ``rec`` conforms to STEP_LOG_FIELDS."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"step record must be a dict, got {type(rec)}")
+    for field, (types, required, _doc) in STEP_LOG_FIELDS.items():
+        if field not in rec:
+            if required:
+                raise ValueError(f"step record missing field '{field}'")
+            continue
+        if not isinstance(rec[field], types):
+            raise ValueError(
+                f"step record field '{field}' has type "
+                f"{type(rec[field]).__name__}, expected one of "
+                f"{[t.__name__ for t in types]}")
+    unknown = set(rec) - set(STEP_LOG_FIELDS)
+    if unknown:
+        raise ValueError(f"step record has unknown fields {sorted(unknown)}")
+    if rec["v"] != STEP_LOG_SCHEMA_VERSION:
+        raise ValueError(
+            f"step record schema v{rec['v']} != "
+            f"v{STEP_LOG_SCHEMA_VERSION}")
+
+
+def step_log_active() -> bool:
+    """True when telemetry is on AND a step_log_path is configured —
+    executors consult this once per step before assembling a record."""
+    return _enabled and bool(_flags.get_flag("step_log_path"))
+
+
+_step_log_warned = False
+
+
+def log_step(record: Dict[str, Any]):
+    """Append one JSONL record to the step log. Fills ``v``, ``ts`` and
+    ``seq``; flushes per line so a live tail (or a test) sees every
+    record. No-op when telemetry is off or no path is configured. An
+    unwritable path warns once and drops records — callers invoke this
+    from ``finally`` blocks, and a telemetry failure must never mask the
+    step's real result (or the exception being recorded)."""
+    global _step_log_file, _step_log_path, _step_seq, _step_log_warned
+    if not step_log_active():
+        return
+    path = _flags.get_flag("step_log_path")
+    with _STEP_LOG_LOCK:
+        try:
+            if _step_log_file is None or path != _step_log_path:
+                if _step_log_file is not None:
+                    try:
+                        _step_log_file.close()
+                    except OSError:
+                        pass
+                _step_log_file = None
+                _step_log_file = open(path, "a")
+                _step_log_path = path
+                _step_log_warned = False
+            record = dict(record)
+            record.setdefault("v", STEP_LOG_SCHEMA_VERSION)
+            record.setdefault("ts", time.time())  # human-readable anchor
+            record["seq"] = _step_seq
+            _step_seq += 1
+            # default=str: a numpy scalar (or anything else json chokes
+            # on) degrades to its string form instead of raising
+            _step_log_file.write(
+                json.dumps(record, sort_keys=True, default=str) + "\n")
+            _step_log_file.flush()
+        except Exception as e:  # never-raise contract: callers log from
+            # finally blocks and the step's real exception must win
+            if not _step_log_warned:
+                _step_log_warned = True
+                import warnings
+
+                warnings.warn(
+                    f"step log write to {path!r} failed; records are "
+                    f"being dropped: {e!r}", RuntimeWarning)
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+_span_seconds: Optional[Histogram] = None
+
+
+def span(name: str):
+    """RAII span with one timeline: always emits a host chrome-trace span
+    through ``profiler.record_event`` (a no-op unless the profiler is
+    on); with telemetry on, additionally times the body with
+    ``perf_counter`` into the ``pt_span_seconds`` histogram labelled by
+    span name. When telemetry is off this returns the record_event
+    context manager directly — byte-identical behavior and allocation
+    profile to calling the profiler yourself."""
+    if not _enabled:
+        return _profiler.record_event(name)
+    return _timed_span(name)
+
+
+@contextlib.contextmanager
+def _timed_span(name: str):
+    global _span_seconds
+    if _span_seconds is None:
+        _span_seconds = histogram(
+            "pt_span_seconds", "host span durations by span name")
+    t0 = time.perf_counter()
+    with _profiler.record_event(name):
+        try:
+            yield
+        finally:
+            _span_seconds.observe(time.perf_counter() - t0,
+                                  labels={"span": name})
+
+
+# register the watcher last so the module is fully initialized when the
+# immediate callback fires
+_flags.watch_flag("telemetry", _sync_from_flags)

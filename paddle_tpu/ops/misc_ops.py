@@ -249,6 +249,9 @@ def _print(ins, attrs):
                 return
             _PRINT_COUNTS[uid] = seen + 1
         arr = np.asarray(arr)
+        # wall clock is ONLY the human-readable stamp on the printed line
+        # (reference print_op format); never difference these — interval
+        # measurement everywhere in this tree uses time.perf_counter().
         parts = [f"{int(time.time())}\t{message}\t"]
         if show_name and var_name:
             parts.append(f"Tensor[{var_name}]")

@@ -28,6 +28,18 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from paddle_tpu import monitor as _monitor
+
+# gpipe() runs at TRACE time (once per compile) — ticks per trace is the
+# schedule length n_micro + n_stages - 1; the bubble fraction falls out
+# of ticks vs microbatches.
+_M_PIPE_TRACES = _monitor.counter(
+    "pt_pipeline_traces_total", "GPipe schedule traces (per compile)")
+_M_PIPE_TICKS = _monitor.counter(
+    "pt_pipeline_ticks_total", "pipeline schedule ticks traced")
+_M_PIPE_MICRO = _monitor.counter(
+    "pt_pipeline_microbatches_total", "microbatches traced through gpipe")
+
 
 def _gpipe_local(params, x_micro, streams, *, fn: Callable, axis: str,
                  n_micro: int, with_micro_idx: bool = False):
@@ -135,6 +147,10 @@ def gpipe(
     n_micro = n_micro or n_stages
     if b % n_micro != 0:
         raise ValueError(f"batch {b} not divisible by n_micro {n_micro}")
+    if _monitor.enabled():
+        _M_PIPE_TRACES.inc()
+        _M_PIPE_TICKS.inc(n_micro + n_stages - 1)
+        _M_PIPE_MICRO.inc(n_micro)
     if data_axis:
         from paddle_tpu.parallel.mesh import axis_size
 

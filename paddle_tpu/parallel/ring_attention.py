@@ -20,7 +20,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu import monitor as _monitor
+
 _NEG_INF = -1e30
+
+# ring_attention() runs at TRACE time (once per compile, not per step) —
+# these count compiled ring programs and the K/V rotations each performs.
+_M_RING_CALLS = _monitor.counter(
+    "pt_ring_attention_traces_total", "ring-attention traces (per compile)")
+_M_RING_ROTATIONS = _monitor.counter(
+    "pt_ring_attention_rotations_total",
+    "K/V ring-rotation steps traced (ring size per trace)")
 
 
 def _ring_attention_local(q, k, v, bias, *, axis_name: str, causal: bool,
@@ -162,6 +172,9 @@ def ring_attention(
     with a source-rank-mixed seed stream."""
     if p_drop > 0.0 and seed is None:
         raise ValueError("ring_attention: p_drop > 0 requires `seed`")
+    if _monitor.enabled():
+        _M_RING_CALLS.inc()
+        _M_RING_ROTATIONS.inc(mesh.shape[seq_axis])
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     d = data_axis

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import warnings
 from typing import Optional
 
 # Fast-path flag so per-step record_event calls cost one attribute check
@@ -42,8 +43,13 @@ def profiler(state: str = "All", sorted_key: Optional[str] = None,
 
             jax.profiler.start_trace(jax_trace_dir)
             jax_started = True
-        except Exception:
-            pass
+        except Exception as e:
+            # a silently-dead xplane capture looks identical to "forgot
+            # to open TensorBoard" — make the failure visible
+            warnings.warn(
+                f"jax.profiler.start_trace({jax_trace_dir!r}) failed; "
+                f"no xplane device trace will be captured: {e!r}",
+                RuntimeWarning, stacklevel=3)
     try:
         yield
     finally:
@@ -52,8 +58,11 @@ def profiler(state: str = "All", sorted_key: Optional[str] = None,
 
             try:
                 jax.profiler.stop_trace()
-            except Exception:
-                pass
+            except Exception as e:
+                warnings.warn(
+                    f"jax.profiler.stop_trace() failed; the xplane trace "
+                    f"under {jax_trace_dir!r} may be missing or "
+                    f"truncated: {e!r}", RuntimeWarning, stacklevel=3)
         if use_native:
             native.profiler_disable()
             _host_enabled = False

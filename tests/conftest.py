@@ -22,7 +22,14 @@ if os.environ.get("PT_TEST_TPU") == "1":
     pass
 else:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax (< 0.5) spells the virtual-device count as an XLA
+        # flag; conftest runs before backend init so this still applies
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
     # Numeric-gradient checks need f64 reference arithmetic.
     jax.config.update("jax_enable_x64", True)
     # Tests are compile-bound on the CPU backend (hundreds of tiny jits);
@@ -59,13 +66,24 @@ def pytest_configure(config):
         "markers",
         "full: expensive deep-parity test, excluded from the default "
         "smoke tier (run with --full or PT_TEST_TIER=full)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running end-to-end test, excluded from the tier-1 "
+        "regression gate (which runs -m 'not slow')")
 
 
 def pytest_collection_modifyitems(config, items):
     if config.getoption("--full") or \
             os.environ.get("PT_TEST_TIER") == "full":
         return
-    dropped = [it for it in items if "full" in it.keywords]
+    # default smoke tier drops 'full' AND 'slow' (unless the caller's -m
+    # expression names 'slow' explicitly, e.g. `-m slow` to run only the
+    # end-to-end tests)
+    drop = {"full"}
+    if "slow" not in (getattr(config.option, "markexpr", "") or ""):
+        drop.add("slow")
+    dropped = [it for it in items if drop & set(it.keywords)]
     if dropped:
         config.hook.pytest_deselected(items=dropped)
-        items[:] = [it for it in items if "full" not in it.keywords]
+        dropped_set = set(dropped)
+        items[:] = [it for it in items if it not in dropped_set]

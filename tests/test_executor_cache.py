@@ -1,0 +1,147 @@
+"""Executor compile-cache accounting: exact hit/miss/eviction counts
+(pt_executor_cache_* counters) and the ``executor_cache_capacity``
+eviction policy — previously untested."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, layers, monitor
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    monitor.reset()
+    flags.set_flags({"telemetry": True, "executor_cache_capacity": 0})
+    yield
+    monitor.reset()
+    flags.set_flags({"telemetry": False, "executor_cache_capacity": 0})
+
+
+def _build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4, 8], append_batch_size=False,
+                        stop_gradient=True)
+        h = layers.fc(x, 4)
+        loss = layers.mean(h)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _counts():
+    return (
+        monitor.counter("pt_executor_cache_hits_total").value(),
+        monitor.counter("pt_executor_cache_misses_total").value(),
+        monitor.counter("pt_executor_cache_evictions_total").value(),
+    )
+
+
+def _feed(batch=4):
+    return {"x": np.ones((batch, 8), np.float32)}
+
+
+def test_hit_miss_counts_exact_across_repeated_runs():
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)                       # miss 1
+        assert _counts() == (0, 1, 0)
+        for i in range(4):                     # miss 2, then 3 hits
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert _counts() == (3, 2, 0)
+        # a different fetch list is a different compiled program
+        exe.run(main, feed=_feed(), fetch_list=[])      # miss 3
+        assert _counts() == (3, 3, 0)
+        exe.run(main, feed=_feed(), fetch_list=[])      # hit 4
+        exe.run(main, feed=_feed(), fetch_list=[loss])  # hit 5
+        assert _counts() == (5, 3, 0)
+        # use_program_cache=False bypasses the cache: no counter movement
+        exe.run(main, feed=_feed(), fetch_list=[loss],
+                use_program_cache=False)
+        assert _counts() == (5, 3, 0)
+
+
+def test_capacity_eviction_fires_and_is_counted():
+    main, startup, loss = _build()
+    flags.set_flags({"executor_cache_capacity": 1})
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)                       # miss; cache = {startup}
+        assert len(exe._cache) == 1
+        # miss; evicts startup (capacity 1)
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert len(exe._cache) == 1
+        assert _counts() == (0, 2, 1)
+        # still cached: hit, no eviction
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert _counts() == (1, 2, 1)
+        # alternate between two signatures at capacity 1: every run
+        # recompiles and evicts the other — the thrash eviction exists
+        # to make visible
+        for _ in range(2):
+            exe.run(main, feed=_feed(), fetch_list=[])
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert _counts() == (1, 6, 5)
+        assert len(exe._cache) == 1
+
+
+def test_failing_step_still_logs_a_record(tmp_path):
+    """A raising step (here: NaN scan) must still append its step-log
+    record — the crashed step is the record a postmortem needs."""
+    import json
+
+    path = tmp_path / "s.jsonl"
+    flags.set_flags({"step_log_path": str(path), "check_nan_inf": True})
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(main, feed=_feed(), fetch_list=[loss])
+            with pytest.raises(FloatingPointError):
+                exe.run(main,
+                        feed={"x": np.full((4, 8), np.nan, np.float32)},
+                        fetch_list=[loss])
+    finally:
+        flags.set_flags({"check_nan_inf": False, "step_log_path": ""})
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    for r in recs:
+        monitor.validate_step_record(r)
+    assert len(recs) == 3
+    assert recs[1]["nan_check"] == "ok"
+    assert recs[2]["nan_check"] == "fail" and recs[2]["wall_ms"] > 0
+
+
+def test_lru_refresh_keeps_hot_entry():
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+        exe.run(main, feed=_feed(), fetch_list=[])
+        # touch the loss entry so it is the most recent...
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert len(exe._cache) == 3
+        # ...then shrink capacity to 2; eviction fires on the next INSERT
+        # (a fresh signature), dropping the two coldest (startup and the
+        # fetch-less entry) and never the refreshed hot entry
+        flags.set_flags({"executor_cache_capacity": 2})
+        monitor.reset()
+        exe.run(main, feed={"x": np.ones((8, 8), np.float32)},
+                fetch_list=[loss])  # new batch size: miss + insert
+        assert len(exe._cache) == 2
+        assert monitor.counter(
+            "pt_executor_cache_evictions_total").value() == 2
+        # the hot (loss-fetching) entry survived: running it again is
+        # a hit, not a recompile
+        before = monitor.counter("pt_executor_cache_misses_total").value()
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert monitor.counter(
+            "pt_executor_cache_misses_total").value() == before
+        assert monitor.counter(
+            "pt_executor_cache_hits_total").value() == 1

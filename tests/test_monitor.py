@@ -1,0 +1,330 @@
+"""Telemetry plane (paddle_tpu/monitor.py): registry semantics, exporter
+round-trips, disabled-path overhead, span unification, step-log schema,
+and the flags plane's self-documentation contract."""
+
+import json
+import tracemalloc
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import flags, layers, monitor
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    monitor.reset()
+    flags.set_flags({"telemetry": False, "step_log_path": "",
+                     "metrics_dump_path": ""})
+    yield
+    monitor.reset()
+    flags.set_flags({"telemetry": False, "step_log_path": "",
+                     "metrics_dump_path": ""})
+
+
+# --------------------------------------------------------------------------
+# registry
+# --------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    monitor.enable()
+    c = monitor.counter("t_c", "a counter")
+    c.inc()
+    c.inc(2, labels={"k": "a"})
+    c.inc(3, labels={"k": "a"})
+    assert c.value() == 1
+    assert c.value(labels={"k": "a"}) == 5
+
+    g = monitor.gauge("t_g", "a gauge")
+    g.set(7.5)
+    g.add(0.5)
+    assert g.value() == 8.0
+
+    h = monitor.histogram("t_h", "a histogram", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 2.0):
+        h.observe(v)
+    assert h.count() == 3
+    assert h.sum() == pytest.approx(2.55)
+
+
+def test_same_name_returns_same_instrument_and_kind_conflict_raises():
+    c1 = monitor.counter("t_dup", "doc")
+    assert monitor.counter("t_dup") is c1
+    with pytest.raises(TypeError):
+        monitor.gauge("t_dup")
+
+
+def test_histogram_bucket_conflict_raises():
+    h = monitor.histogram("t_hb", "h", buckets=(1.0, 2.0))
+    assert monitor.histogram("t_hb", buckets=(2.0, 1.0)) is h  # same set
+    with pytest.raises(ValueError, match="buckets"):
+        monitor.histogram("t_hb", buckets=(5.0,))
+
+
+def test_disabled_calls_are_inert_and_allocation_free():
+    """With telemetry off (the default), instrument calls must return
+    after the flag check: no label cells materialize and no allocations
+    are attributable to monitor.py — the hot-path contract that lets the
+    executor stay permanently instrumented."""
+    assert not monitor.enabled()
+    c = monitor.counter("t_off_c", "off")
+    g = monitor.gauge("t_off_g", "off")
+    h = monitor.histogram("t_off_h", "off")
+    # warm up (first calls may touch lazy interpreter state)
+    c.inc()
+    g.set(1)
+    h.observe(1)
+
+    n_calls = 5 * 1000
+    tracemalloc.start()
+    base = tracemalloc.take_snapshot()
+    for _ in range(1000):
+        c.inc()
+        c.inc(2)
+        g.set(3)
+        g.add(1)
+        h.observe(0.5)
+    snap = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+
+    stats = snap.compare_to(base, "filename")
+    grew = sum(s.size_diff for s in stats
+               if s.traceback[0].filename.endswith("monitor.py")
+               and s.size_diff > 0)
+    # any real per-call allocation would show as >= n_calls * 16 bytes;
+    # allow constant interpreter noise (~hundreds of bytes), not growth
+    assert grew < n_calls, f"disabled path allocated {grew}B/{n_calls} calls"
+    assert c.value() == 0 and g.value() == 0 and h.count() == 0
+    assert not c._cells and not g._cells and not h._cells
+
+
+def test_runtime_flag_flip_takes_effect_immediately():
+    c = monitor.counter("t_flip", "flip")
+    c.inc()
+    assert c.value() == 0
+    flags.set_flags({"telemetry": True})
+    c.inc()
+    assert c.value() == 1
+    flags.set_flags({"telemetry": False})
+    c.inc()
+    assert c.value() == 1
+
+
+# --------------------------------------------------------------------------
+# exporters
+# --------------------------------------------------------------------------
+
+def _parse_prometheus(text):
+    """sample name+labels -> float value (enough to verify round-trip)."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, val = line.rsplit(" ", 1)
+        out[key] = float(val)
+    return out
+
+
+def test_dump_metrics_round_trips_prometheus_and_json(tmp_path):
+    monitor.enable()
+    monitor.counter("t_exp_c", "requests").inc(4, labels={"route": "a/b"})
+    monitor.gauge("t_exp_g", "depth").set(2.5)
+    h = monitor.histogram("t_exp_h", "lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    # JSON: parseable, values intact
+    j = json.loads(monitor.dump_metrics(fmt="json"))
+    assert j["t_exp_c"]["kind"] == "counter"
+    assert j["t_exp_c"]["values"][0] == {
+        "labels": {"route": "a/b"}, "value": 4.0}
+    assert j["t_exp_g"]["values"][0]["value"] == 2.5
+    hist = j["t_exp_h"]["values"][0]
+    assert hist["count"] == 3
+    assert hist["buckets"] == [[0.1, 1], [1.0, 2], ["+Inf", 3]]
+
+    # Prometheus text: parseable, same numbers, cumulative buckets
+    prom = _parse_prometheus(monitor.dump_metrics(fmt="prometheus"))
+    assert prom['t_exp_c{route="a/b"}'] == 4.0
+    assert prom["t_exp_g"] == 2.5
+    assert prom['t_exp_h_bucket{le="0.1"}'] == 1
+    assert prom['t_exp_h_bucket{le="1.0"}'] == 2
+    assert prom['t_exp_h_bucket{le="+Inf"}'] == 3
+    assert prom["t_exp_h_count"] == 3
+    assert prom["t_exp_h_sum"] == pytest.approx(5.55)
+
+    # file write path (explicit arg and flag-driven)
+    p = tmp_path / "m.prom"
+    monitor.dump_metrics(path=str(p))
+    assert _parse_prometheus(p.read_text())["t_exp_g"] == 2.5
+    flags.set_flags({"metrics_dump_path": str(tmp_path / "m.json")})
+    monitor.dump_metrics(fmt="json")
+    assert json.loads((tmp_path / "m.json").read_text())["t_exp_g"]
+
+
+def test_bad_format_raises():
+    with pytest.raises(ValueError):
+        monitor.dump_metrics(fmt="xml")
+
+
+# --------------------------------------------------------------------------
+# spans
+# --------------------------------------------------------------------------
+
+def test_span_feeds_histogram_when_enabled():
+    monitor.enable()
+    with monitor.span("test.scope"):
+        pass
+    h = monitor.histogram("pt_span_seconds")
+    assert h.count(labels={"span": "test.scope"}) == 1
+
+    flags.set_flags({"telemetry": False})
+    with monitor.span("test.scope"):
+        pass
+    assert h.count(labels={"span": "test.scope"}) == 1  # unchanged
+
+
+# --------------------------------------------------------------------------
+# step log
+# --------------------------------------------------------------------------
+
+def test_log_step_writes_versioned_jsonl(tmp_path):
+    path = tmp_path / "steps.jsonl"
+    monitor.enable(step_log_path=str(path))
+    base = {"kind": "step", "step": 0, "wall_ms": 1.0, "compile_ms": None,
+            "cache": "hit", "evictions": 0, "feed_bytes": 0,
+            "fetch_bytes": 0, "nan_check": None, "strategy": None}
+    monitor.log_step(dict(base))
+    monitor.log_step(dict(base, step=1))
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["seq"] for r in recs] == [0, 1]
+    for r in recs:
+        assert r["v"] == monitor.STEP_LOG_SCHEMA_VERSION
+        monitor.validate_step_record(r)
+
+
+def test_validate_step_record_rejects_bad_records():
+    good = {"v": monitor.STEP_LOG_SCHEMA_VERSION, "ts": 0.0, "seq": 0,
+            "kind": "step", "step": 0, "wall_ms": 1.0, "compile_ms": None,
+            "cache": "miss", "evictions": 0, "feed_bytes": 0,
+            "fetch_bytes": 0, "nan_check": "ok", "strategy": None}
+    monitor.validate_step_record(good)
+    with pytest.raises(ValueError, match="missing field"):
+        monitor.validate_step_record({k: v for k, v in good.items()
+                                      if k != "cache"})
+    with pytest.raises(ValueError, match="type"):
+        monitor.validate_step_record(dict(good, step="zero"))
+    with pytest.raises(ValueError, match="unknown fields"):
+        monitor.validate_step_record(dict(good, bogus=1))
+    with pytest.raises(ValueError, match="schema"):
+        monitor.validate_step_record(dict(good, v=999))
+
+
+def test_log_step_unwritable_path_warns_once_never_raises(tmp_path):
+    """Executors call log_step from finally blocks: a bad path must not
+    mask the step's real result (or a propagating exception)."""
+    monitor.enable(step_log_path=str(tmp_path / "no" / "such" / "s.jsonl"))
+    rec = {"kind": "step", "step": 0, "wall_ms": 1.0, "compile_ms": None,
+           "cache": "hit", "evictions": 0, "feed_bytes": 0,
+           "fetch_bytes": 0, "nan_check": None, "strategy": None}
+    with pytest.warns(RuntimeWarning, match="step log"):
+        monitor.log_step(dict(rec))
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        monitor.log_step(dict(rec))  # warn-once: silent, and no raise
+
+
+def test_log_step_noop_without_path_or_telemetry(tmp_path):
+    monitor.log_step({"kind": "step"})  # no telemetry: no error, no file
+    flags.set_flags({"telemetry": True})
+    monitor.log_step({"kind": "step"})  # no path: still a no-op
+    assert not monitor.step_log_active()
+
+
+# --------------------------------------------------------------------------
+# flags plane self-documentation (satellite)
+# --------------------------------------------------------------------------
+
+def test_describe_flags_covers_every_flag_with_docs():
+    table = flags.describe_flags()
+    names = [row["name"] for row in table]
+    assert names == sorted(names)
+    assert set(names) == set(flags.get_flags())
+    for row in table:
+        assert row["type"] in ("bool", "int", "str"), row
+        assert isinstance(row["doc"], str) and row["doc"].strip(), (
+            f"flag '{row['name']}' has no doc string")
+        assert row["value"] == flags.get_flag(row["name"])
+    by_name = {r["name"]: r for r in table}
+    assert by_name["telemetry"]["default"] is False
+
+
+def test_watch_flag_fires_immediately_and_on_change():
+    seen = []
+    flags.watch_flag("benchmark", seen.append)
+    assert seen == [False]
+    flags.set_flags({"benchmark": True})
+    assert seen == [False, True]
+    flags.set_flags({"benchmark": False})
+    assert seen == [False, True, False]
+    with pytest.raises(KeyError):
+        flags.watch_flag("no_such_flag", seen.append)
+
+
+# --------------------------------------------------------------------------
+# end-to-end: 3 training steps of the MNIST model produce a valid step
+# log whose cache accounting matches ground truth
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_mnist_three_step_train_emits_valid_step_log(tmp_path):
+    from paddle_tpu.models import mnist as mnist_model
+
+    path = tmp_path / "mnist_steps.jsonl"
+    monitor.enable(step_log_path=str(path))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        model = mnist_model.get_model(use_conv=False)
+        fluid.optimizer.SGD(0.1).minimize(model["loss"])
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            feed = {
+                "pixel": rng.rand(16, 784).astype(np.float32),
+                "label": rng.randint(0, 10, (16, 1)).astype(np.int64),
+            }
+            exe.run(main, feed=feed, fetch_list=[model["loss"]])
+
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    for r in recs:
+        monitor.validate_step_record(r)
+    # startup + 3 train steps, one record each
+    assert len(recs) == 4
+    assert [r["kind"] for r in recs] == ["step"] * 4
+    train = recs[1:]
+    # ground truth: first train step compiles, the rest hit the cache
+    assert [r["cache"] for r in train] == ["miss", "hit", "hit"]
+    assert train[0]["compile_ms"] is not None and train[0]["compile_ms"] > 0
+    assert all(r["compile_ms"] is None for r in train[1:])
+    assert all(r["feed_bytes"] == 16 * 784 * 4 + 16 * 8 for r in train)
+    assert all(r["fetch_bytes"] > 0 for r in train)
+    assert all(r["wall_ms"] > 0 for r in train)
+    assert [r["step"] for r in recs] == [0, 1, 2, 3]
+
+    # registry agrees with the log
+    assert monitor.counter(
+        "pt_executor_cache_hits_total").value() == 2
+    assert monitor.counter(
+        "pt_executor_cache_misses_total").value() == 2  # startup + train
+    # exporters round-trip on the live registry
+    assert json.loads(monitor.dump_metrics(fmt="json"))
+    assert "pt_executor_cache_hits_total 2.0" in monitor.dump_metrics(
+        fmt="prometheus")

@@ -537,13 +537,13 @@ def test_multiprocess_reader_ndarray_samples_and_errors():
         for i in range(100000):
             yield np.zeros(16)
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     it = decorator.multiprocess_reader([big_reader, big_reader],
                                        queue_size=8)()
     for _, _s in zip(range(3), it):
         pass
     it.close()  # early exit must terminate workers promptly
-    assert time.time() - t0 < 5.0
+    assert time.perf_counter() - t0 < 5.0
 
 
 def test_bilinear_tensor_product_op():
