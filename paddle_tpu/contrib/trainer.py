@@ -206,7 +206,13 @@ class Trainer:
                         if self._stopped:
                             break
                         handler(BeginStepEvent(epoch, step))
-                        with _monitor.span("trainer.step"):
+                        # the step IS the collective in fleet jobs (GSPMD
+                        # all-reduces ride inside the compiled program):
+                        # a dead peer shows up as THIS call never
+                        # returning, which the watchdog turns into a
+                        # stall record with the span stack
+                        with _monitor.span("trainer.step"), \
+                                _monitor.stall_guard("trainer.step"):
                             metrics = self.exe.run(
                                 self._run_program,
                                 feed=feeder.feed(batch),

@@ -9,9 +9,48 @@ from typing import Optional
 from paddle_tpu.framework import Program
 
 
-def pprint_program(program: Program, with_shapes: bool = True) -> str:
-    """Readable multi-block listing of a Program's vars and ops."""
+def _compile_report_lines(program: Program) -> list:
+    """Annotation header from the program's latest compile report (if the
+    telemetry plane recorded one): the listing then answers not just
+    "what ops" but "what do they cost compiled"."""
+    from paddle_tpu import monitor
+
+    rep = monitor.compile_reports().get(f"program{program._uid}")
+    if rep is None:
+        return []
+
+    def _fmt(v, unit=""):
+        if v is None:
+            return "null"
+        if unit == "B":
+            return f"{int(v):,} B"
+        return f"{v:,.0f}" if isinstance(v, float) else f"{v:,}"
+
+    # .get throughout: record_compile_report accepts (and never rejects)
+    # hand-built reports, and the debugging utility must not crash on one
+    return [
+        f"compile report (v{rep.get('v')}, source={rep.get('source')}, "
+        f"backend={rep.get('backend')}):",
+        f"  flops={_fmt(rep.get('flops'))} "
+        f"bytes_accessed={_fmt(rep.get('bytes_accessed'))}",
+        f"  peak={_fmt(rep.get('peak_bytes'), 'B')} "
+        f"(args={_fmt(rep.get('argument_bytes'), 'B')} "
+        f"out={_fmt(rep.get('output_bytes'), 'B')} "
+        f"temp={_fmt(rep.get('temp_bytes'), 'B')})",
+        f"  n_ops={rep.get('n_ops')} "
+        f"compile_ms={_fmt(rep.get('compile_ms'))} "
+        f"analysis_ms={_fmt(rep.get('analysis_ms'))}",
+    ]
+
+
+def pprint_program(program: Program, with_shapes: bool = True,
+                   with_compile_report: bool = True) -> str:
+    """Readable multi-block listing of a Program's vars and ops,
+    prefixed with the latest compile-report annotation when telemetry
+    recorded one (``with_compile_report=False`` opts out)."""
     lines = []
+    if with_compile_report:
+        lines.extend(_compile_report_lines(program))
     for block in program.blocks:
         lines.append(f"block {block.idx}:")
         for name, var in sorted(block.vars.items()):

@@ -206,6 +206,12 @@ class Executor:
                 program, compiled, feed_names, fetch_names, scope
             )
 
+        if (tele and _monitor.memory_budget_bytes() > 0
+                and (not use_program_cache or key not in self._cache)):
+            # pre-flight BEFORE paying for the compile: a program whose
+            # static estimate already exceeds the device budget warns now
+            _monitor.check_memory_budget(
+                program, {k: np.shape(v) for k, v in feed_vals.items()})
         if use_program_cache:
             entry, cache_hit, evictions, compile_ms = self._cache_entry(
                 key, build)
@@ -235,10 +241,33 @@ class Executor:
         strategy = compiled._strategy if compiled is not None else None
         rec = None
         if tele:
+            # plain data parallelism has a mesh but no DistributedStrategy
+            # object; the mesh axes are the strategy id either way
+            strat_src = strategy
+            if (strat_src is None and compiled is not None
+                    and compiled.mesh is not None):
+                strat_src = compiled
+            strat_label = _strategy_id(strat_src)
             _M_STEPS.inc()
             feed_bytes = _sum_nbytes(feed_vals.values())
             _M_FEED_BYTES.inc(feed_bytes)
-            if _monitor.step_log_active():
+            if not cache_hit and _monitor.compile_reports_active():
+                # fresh compile: produce the cost/memory report BEFORE
+                # the step executes (lowering only reads avals; after
+                # the call the donated state buffers are deleted). The
+                # SPMD context scope matters: collective ops read it at
+                # trace time.
+                with _interp.spmd_ctx_scope(strategy):
+                    _monitor.record_compile_report(
+                        lowering.build_compile_report(
+                            fn, lowered,
+                            (state, feed_vals, base_key,
+                             np.uint32(step_idx)),
+                            program=program, kind="step",
+                            compile_ms=compile_ms,
+                            strategy=strat_label,
+                            cache_key=key))
+            if _monitor.step_records_active():
                 rec = {
                     "kind": "step",
                     "step": step_idx,
@@ -248,7 +277,7 @@ class Executor:
                     "feed_bytes": feed_bytes,
                     "fetch_bytes": 0,
                     "nan_check": None,
-                    "strategy": _strategy_id(strategy),
+                    "strategy": strat_label,
                 }
         try:
             with _interp.spmd_ctx_scope(strategy), \
@@ -359,6 +388,12 @@ class Executor:
             return (lowering.jit_lowered_multi(lowered, len(feed_list)),
                     lowered)
 
+        if (tele and _monitor.memory_budget_bytes() > 0
+                and key not in self._cache):
+            # per-step feed shapes: drop the stacked window axis
+            _monitor.check_memory_budget(
+                program,
+                {k: tuple(v.shape[1:]) for k, v in stacked.items()})
         entry, cache_hit, evictions, compile_ms = self._cache_entry(
             key, build)
         fn, lowered = entry
@@ -371,7 +406,16 @@ class Executor:
             _M_STEPS.inc(int(steps))
             feed_bytes = _sum_nbytes(stacked.values())
             _M_FEED_BYTES.inc(feed_bytes)
-            if _monitor.step_log_active():
+            if not cache_hit and _monitor.compile_reports_active():
+                _monitor.record_compile_report(
+                    lowering.build_compile_report(
+                        fn, lowered,
+                        (state, stacked, base_key, np.uint32(start),
+                         int(steps)),
+                        program=program, kind="window",
+                        compile_ms=compile_ms, strategy=None,
+                        cache_key=key))
+            if _monitor.step_records_active():
                 rec = {
                     "kind": "window",
                     "step": start,

@@ -41,6 +41,28 @@ _DEFS: Dict[str, tuple] = {
     # monitor.dump_metrics() target; also dumped at process exit while
     # telemetry is on
     "metrics_dump_path": (str, "", "metrics export file path"),
+    # per-program compile reports (monitor.COMPILE_REPORT_FIELDS schema):
+    # one JSON file per fresh executor compile, written here. Costs one
+    # extra AOT lower+compile per cache miss (jax shares no compile cache
+    # between the analysis path and the eager jit); empty = off
+    "compile_report_dir": (str, "", "per-compile JSON report directory"),
+    # live observability endpoint (monitor.serve): /metrics /healthz
+    # /steps /compile on this port; 0 = no server. Needs `telemetry`.
+    "metrics_port": (int, 0, "HTTP port for the live /metrics endpoint"),
+    # pre-flight memory budget: before a fresh compile the executor runs
+    # monitor.estimate_memory and warns when the static estimate exceeds
+    # this many bytes; 0 = no pre-flight
+    "device_memory_budget_bytes": (int, 0,
+                                   "warn threshold for pre-compile "
+                                   "memory estimates"),
+    # collective stall watchdog: guarded blocking sections (fleet
+    # barriers/rendezvous, ring-attention / pipeline dispatch) that
+    # exceed this deadline increment pt_stall_total and log a structured
+    # stall record; 0 = watchdog disarmed
+    "stall_timeout_ms": (int, 0, "watchdog deadline for collectives"),
+    # on a stall, also dump the flight recorder (step ring buffer +
+    # metrics snapshot + stall record) as JSON into this directory
+    "stall_dump_dir": (str, "", "flight-recorder dump dir on stall"),
 }
 
 _values: Dict[str, Any] = {}

@@ -92,7 +92,8 @@ class Fleet:
                 )
             host, port = endpoint.rsplit(":", 1)
             port = int(port)
-            with _monitor.span("fleet.rendezvous"):
+            with _monitor.span("fleet.rendezvous"), \
+                    _monitor.stall_guard("fleet.rendezvous"):
                 if self._role.is_first_worker():
                     self._server = native.CoordServer(port)
                 # workers retry-connect until rank 0's server is up
@@ -155,7 +156,8 @@ class Fleet:
             # profiler on this still lands in the chrome trace, with
             # only telemetry on it still feeds the histogram
             t0 = _time.perf_counter()
-            with _monitor.span("fleet.barrier"):
+            with _monitor.span("fleet.barrier"), \
+                    _monitor.stall_guard("fleet.barrier"):
                 self._client.barrier(name, self.worker_num())
             _M_BARRIER_WAIT.observe(_time.perf_counter() - t0,
                                     labels={"barrier": _barrier_label(name)})
@@ -172,7 +174,10 @@ class Fleet:
             from paddle_tpu import flags as _flags
 
             timeout_ms = _flags.get_flag("rpc_deadline_ms")
-        return self._client.get(key, timeout_ms=timeout_ms)
+        # a blocked KV get is the classic "peer never published its key"
+        # hang (e.g. waiting out a partner's multi-minute first compile)
+        with _monitor.stall_guard("fleet.kv_get"):
+            return self._client.get(key, timeout_ms=timeout_ms)
 
     # --- failure detection (SURVEY.md section 5) ---
 
@@ -232,40 +237,48 @@ class Fleet:
                 pass  # hygiene only; never fail the barrier for it
         self._client.put(f"fleet/arrive/{tag}/{me}", b"1")
         deadline = _time.monotonic() + timeout_ms / 1000.0
-        while True:
-            self._client.heartbeat(f"worker-{me}")
-            missing = []
-            for r in range(self.worker_num()):
-                if r == me:
-                    continue
-                try:
-                    self._client.get(f"fleet/arrive/{tag}/{r}",
-                                     timeout_ms=0)
-                except TimeoutError:
-                    missing.append(r)
-            if not missing:
-                self._done_barriers.append(tag)
-                _M_BARRIER_WAIT.observe(_time.perf_counter() - t_wait0,
-                                        labels={"barrier": _barrier_label(name)})
-                return []
-            dead = list(self._client.dead_peers(max_age_ms))
-            dead_missing = [d for d in dead
-                            if any(d == f"worker-{r}" for r in missing)]
-            if dead_missing:
-                self._done_barriers = []
-                _M_DEAD_EVENTS.inc()
-                _M_BARRIER_WAIT.observe(_time.perf_counter() - t_wait0,
-                                        labels={"barrier": _barrier_label(name)})
-                return dead_missing
-            if _time.monotonic() > deadline:
-                # the timeout IS the pathological wait this histogram
-                # exists to surface — record it before raising
-                _M_BARRIER_WAIT.observe(_time.perf_counter() - t_wait0,
-                                        labels={"barrier": _barrier_label(name)})
-                raise TimeoutError(
-                    f"barrier_or_dead {name!r}: workers {missing} neither "
-                    f"arrived nor declared dead within {timeout_ms} ms")
-            _time.sleep(poll_ms / 1000.0)
+        # The watchdog fires well before timeout_ms (its deadline is the
+        # stall_timeout_ms flag): a stall record with the span stack
+        # beats staring at a silent poll loop for two minutes.
+        with _monitor.stall_guard("fleet.barrier_or_dead"):
+            while True:
+                self._client.heartbeat(f"worker-{me}")
+                missing = []
+                for r in range(self.worker_num()):
+                    if r == me:
+                        continue
+                    try:
+                        self._client.get(f"fleet/arrive/{tag}/{r}",
+                                         timeout_ms=0)
+                    except TimeoutError:
+                        missing.append(r)
+                if not missing:
+                    self._done_barriers.append(tag)
+                    _M_BARRIER_WAIT.observe(
+                        _time.perf_counter() - t_wait0,
+                        labels={"barrier": _barrier_label(name)})
+                    return []
+                dead = list(self._client.dead_peers(max_age_ms))
+                dead_missing = [d for d in dead
+                                if any(d == f"worker-{r}" for r in missing)]
+                if dead_missing:
+                    self._done_barriers = []
+                    _M_DEAD_EVENTS.inc()
+                    _M_BARRIER_WAIT.observe(
+                        _time.perf_counter() - t_wait0,
+                        labels={"barrier": _barrier_label(name)})
+                    return dead_missing
+                if _time.monotonic() > deadline:
+                    # the timeout IS the pathological wait this histogram
+                    # exists to surface — record it before raising
+                    _M_BARRIER_WAIT.observe(
+                        _time.perf_counter() - t_wait0,
+                        labels={"barrier": _barrier_label(name)})
+                    raise TimeoutError(
+                        f"barrier_or_dead {name!r}: workers {missing} "
+                        f"neither arrived nor declared dead within "
+                        f"{timeout_ms} ms")
+                _time.sleep(poll_ms / 1000.0)
 
     # --- program compilation over the global mesh ---
 

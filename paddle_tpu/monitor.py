@@ -1,4 +1,5 @@
-"""Runtime telemetry plane: metrics registry, structured step logs, spans.
+"""Runtime telemetry plane: metrics, step logs, spans, compile reports,
+the live /metrics endpoint, and the collective stall watchdog.
 
 The reference framework shipped a real observability stack (RecordEvent
 host spans + CUPTI DeviceTracer + tools/timeline.py chrome traces); this
@@ -27,20 +28,47 @@ process-wide plane with three pillars.
    ``time.perf_counter`` — wall clock is only ever used for
    human-readable timestamps).
 
-Everything is off by default behind the typed flags ``telemetry``,
-``step_log_path`` and ``metrics_dump_path`` (flags.py); flipping
-``telemetry`` at runtime takes effect immediately via a flag watcher.
+Grown in PR 2 with the compile & memory observability plane:
+
+4. **Compile reports** — ``record_compile_report`` stores one versioned
+   JSON document per fresh executor compile (XLA flops / bytes accessed /
+   device-memory breakdown, op-lowering histogram; schema in
+   ``COMPILE_REPORT_FIELDS``), written under the ``compile_report_dir``
+   flag and mirrored into ``pt_compile_*`` gauges.
+   ``estimate_memory(program, feed_shapes)`` is the static pre-flight
+   twin: a shape-table estimate that can warn BEFORE a compile that
+   would blow the ``device_memory_budget_bytes`` flag.
+
+5. **Live endpoint** — ``serve(port)`` (or the ``metrics_port`` flag)
+   runs a stdlib ``http.server`` background thread on localhost with
+   ``/metrics`` (Prometheus text), ``/healthz``, ``/steps`` (the bounded
+   step ring buffer) and ``/compile`` (latest compile reports). Zero
+   dependencies beyond the standard library.
+
+6. **Stall watchdog** — ``stall_guard(name)`` arms a timer around
+   blocking collectives (fleet barriers/rendezvous, ring-attention and
+   pipeline dispatch); past the ``stall_timeout_ms`` deadline it
+   increments ``pt_stall_total``, records a structured stall record
+   carrying the active span stack + last step record, and (gated on
+   ``stall_dump_dir``) dumps the flight recorder to disk.
+
+Everything is off by default behind typed flags (flags.py); flipping
+``telemetry`` at runtime takes effect immediately via a flag watcher,
+and every disabled instrument call costs one module-level boolean check.
 """
 
 from __future__ import annotations
 
 import atexit
+import collections
 import contextlib
 import io
 import json
+import os
 import threading
 import time
-from typing import Any, Dict, Iterable, Optional, Tuple
+import warnings
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from paddle_tpu import flags as _flags
 from paddle_tpu import profiler as _profiler
@@ -106,24 +134,72 @@ def _label_key(labels: Optional[Dict[str, Any]]) -> _LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+# Label-cardinality cap: a mis-labelled hot-path metric (step index or a
+# raw barrier name in a label) would otherwise grow one cell per distinct
+# value forever — registry memory AND the Prometheus payload. Past
+# MAX_LABEL_SETS distinct label-sets, new ones collapse into one
+# overflow="true" cell; the first drop warns and every drop counts into
+# pt_metric_label_overflow_total{metric=...}.
+MAX_LABEL_SETS = 64
+_OVERFLOW_KEY: _LabelKey = (("overflow", "true"),)
+
+
+def _capped_key(metric, key: _LabelKey):
+    """(effective key, dropped, first-drop) — caller holds _LOCK."""
+    cells = metric._cells
+    if key in cells or key == _OVERFLOW_KEY or len(cells) < MAX_LABEL_SETS:
+        return key, False, False
+    first = not metric._overflowed
+    metric._overflowed = True
+    return _OVERFLOW_KEY, True, first
+
+
+def _note_overflow(name: str, first: bool):
+    """Post-mutation bookkeeping, outside _LOCK (the overflow counter's
+    own inc takes it)."""
+    if first:
+        warnings.warn(
+            f"metric '{name}' exceeded {MAX_LABEL_SETS} distinct "
+            f"label-sets; further label-sets collapse into "
+            f'overflow="true"', RuntimeWarning)
+    _overflow_total().inc(labels={"metric": name})
+
+
+_overflow_counter: Optional["Counter"] = None
+
+
+def _overflow_total() -> "Counter":
+    global _overflow_counter
+    if _overflow_counter is None:
+        _overflow_counter = counter(
+            "pt_metric_label_overflow_total",
+            "metric mutations dropped into the overflow label bucket "
+            "after MAX_LABEL_SETS distinct label-sets, by metric")
+    return _overflow_counter
+
+
 class Counter:
     """Monotonic counter. ``inc`` is a no-op (one flag check, zero
     allocations) while telemetry is off."""
 
     kind = "counter"
-    __slots__ = ("name", "doc", "_cells")
+    __slots__ = ("name", "doc", "_cells", "_overflowed")
 
     def __init__(self, name: str, doc: str):
         self.name = name
         self.doc = doc
         self._cells: Dict[_LabelKey, float] = {}
+        self._overflowed = False
 
     def inc(self, n: float = 1, labels: Optional[Dict[str, Any]] = None):
         if not _enabled:
             return
         key = _label_key(labels)
         with _LOCK:
+            key, dropped, first = _capped_key(self, key)
             self._cells[key] = self._cells.get(key, 0.0) + n
+        if dropped:
+            _note_overflow(self.name, first)
 
     def value(self, labels: Optional[Dict[str, Any]] = None) -> float:
         return self._cells.get(_label_key(labels), 0.0)
@@ -133,25 +209,33 @@ class Gauge:
     """Last-value instrument (``set``) with an ``add`` for +/- deltas."""
 
     kind = "gauge"
-    __slots__ = ("name", "doc", "_cells")
+    __slots__ = ("name", "doc", "_cells", "_overflowed")
 
     def __init__(self, name: str, doc: str):
         self.name = name
         self.doc = doc
         self._cells: Dict[_LabelKey, float] = {}
+        self._overflowed = False
 
     def set(self, v: float, labels: Optional[Dict[str, Any]] = None):
         if not _enabled:
             return
+        key = _label_key(labels)
         with _LOCK:
-            self._cells[_label_key(labels)] = float(v)
+            key, dropped, first = _capped_key(self, key)
+            self._cells[key] = float(v)
+        if dropped:
+            _note_overflow(self.name, first)
 
     def add(self, n: float = 1, labels: Optional[Dict[str, Any]] = None):
         if not _enabled:
             return
         key = _label_key(labels)
         with _LOCK:
+            key, dropped, first = _capped_key(self, key)
             self._cells[key] = self._cells.get(key, 0.0) + n
+        if dropped:
+            _note_overflow(self.name, first)
 
     def value(self, labels: Optional[Dict[str, Any]] = None) -> float:
         return self._cells.get(_label_key(labels), 0.0)
@@ -169,7 +253,7 @@ class Histogram:
     counts observations <= its upper bound; +Inf is implicit)."""
 
     kind = "histogram"
-    __slots__ = ("name", "doc", "buckets", "_cells")
+    __slots__ = ("name", "doc", "buckets", "_cells", "_overflowed")
 
     def __init__(self, name: str, doc: str,
                  buckets: Iterable[float] = DEFAULT_BUCKETS):
@@ -178,6 +262,7 @@ class Histogram:
         self.buckets = tuple(sorted(float(b) for b in buckets))
         # cell: [counts per bucket..., +inf count, sum]
         self._cells: Dict[_LabelKey, list] = {}
+        self._overflowed = False
 
     def observe(self, v: float, labels: Optional[Dict[str, Any]] = None):
         if not _enabled:
@@ -185,6 +270,7 @@ class Histogram:
         v = float(v)
         key = _label_key(labels)
         with _LOCK:
+            key, dropped, first = _capped_key(self, key)
             cell = self._cells.get(key)
             if cell is None:
                 cell = [0] * (len(self.buckets) + 1) + [0.0]
@@ -196,6 +282,8 @@ class Histogram:
             else:
                 cell[len(self.buckets)] += 1
             cell[-1] += v
+        if dropped:
+            _note_overflow(self.name, first)
 
     def count(self, labels: Optional[Dict[str, Any]] = None) -> int:
         cell = self._cells.get(_label_key(labels))
@@ -204,6 +292,39 @@ class Histogram:
     def sum(self, labels: Optional[Dict[str, Any]] = None) -> float:
         cell = self._cells.get(_label_key(labels))
         return float(cell[-1]) if cell else 0.0
+
+    def quantile(self, q: float,
+                 labels: Optional[Dict[str, Any]] = None) -> Optional[float]:
+        """Bucket-interpolated quantile estimate (None when empty)."""
+        cell = self._cells.get(_label_key(labels))
+        if not cell:
+            return None
+        return _hist_quantile(self.buckets, cell, q)
+
+
+# quantile summaries exported alongside the raw buckets so the p50/p95/p99
+# of barrier waits or compile times are readable without a Prometheus
+# server doing histogram_quantile() for you
+QUANTILE_LABELS = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
+
+def _hist_quantile(bounds, cell, q: float) -> Optional[float]:
+    """Linear interpolation inside the target bucket (the same estimate
+    Prometheus's histogram_quantile makes). Observations in the +Inf
+    bucket clamp to the top finite bound."""
+    total = sum(cell[:-1])
+    if total == 0:
+        return None
+    target = q * total
+    acc = 0.0
+    lo = 0.0
+    for i, ub in enumerate(bounds):
+        c = cell[i]
+        if c and acc + c >= target:
+            return lo + (ub - lo) * ((target - acc) / c)
+        acc += c
+        lo = ub
+    return bounds[-1] if bounds else 0.0
 
 
 _REGISTRY: Dict[str, Any] = {}
@@ -250,9 +371,11 @@ def reset():
     references to them, so dropping the registry would orphan live
     instruments into invisible counters."""
     global _step_log_file, _step_log_path, _step_seq, _step_log_warned
+    global _stall_seq
     with _LOCK:
         for m in _REGISTRY.values():
             m._cells.clear()
+            m._overflowed = False
     with _STEP_LOG_LOCK:
         _step_log_warned = False
         if _step_log_file is not None:
@@ -263,6 +386,11 @@ def reset():
         _step_log_file = None
         _step_log_path = ""
         _step_seq = 0
+        _STEP_RING.clear()
+    with _COMPILE_LOCK:
+        _COMPILE_REPORTS.clear()
+    _STALLS.clear()
+    _stall_seq = 0
 
 
 def snapshot() -> Dict[str, Any]:
@@ -286,8 +414,11 @@ def snapshot() -> Dict[str, Any]:
                         cum.append([ub, acc])
                     acc += cell[len(m.buckets)]
                     cum.append(["+Inf", acc])
-                    values.append({"labels": labels, "count": acc,
-                                   "sum": cell[-1], "buckets": cum})
+                    val = {"labels": labels, "count": acc,
+                           "sum": cell[-1], "buckets": cum}
+                    for qname, q in QUANTILE_LABELS:
+                        val[qname] = _hist_quantile(m.buckets, cell, q)
+                    values.append(val)
                 else:
                     values.append({"labels": labels, "value": cell})
             out[name] = {"kind": m.kind, "doc": m.doc, "values": values}
@@ -329,6 +460,11 @@ def to_prometheus(snap: Optional[Dict[str, Any]] = None) -> str:
                     f"{name}_sum{_prom_labels(labels)} {cell['sum']}")
                 lines.append(
                     f"{name}_count{_prom_labels(labels)} {cell['count']}")
+                for qname, _q in QUANTILE_LABELS:
+                    if cell.get(qname) is not None:
+                        lines.append(
+                            f"{name}_{qname}"
+                            f"{_prom_labels(labels)} {cell[qname]}")
             else:
                 lines.append(
                     f"{name}{_prom_labels(labels)} {cell['value']}")
@@ -426,26 +562,60 @@ def validate_step_record(rec: Dict[str, Any]):
 
 
 def step_log_active() -> bool:
-    """True when telemetry is on AND a step_log_path is configured —
-    executors consult this once per step before assembling a record."""
+    """True when telemetry is on AND a step_log_path is configured."""
     return _enabled and bool(_flags.get_flag("step_log_path"))
+
+
+def step_records_active() -> bool:
+    """True when executors should assemble per-step records: with
+    telemetry on every record feeds the in-memory ring buffer (the
+    /steps endpoint + flight recorder), whether or not a step_log_path
+    routes them to disk too."""
+    return _enabled
+
+
+# Bounded flight-recorder ring of the last N step records. Fed by every
+# log_step call; served by /steps and dumped by the stall watchdog. The
+# deque bound is the memory contract — a week-long job holds the same
+# 256 records as a smoke test.
+STEP_RING_CAPACITY = 256
+_STEP_RING: collections.deque = collections.deque(maxlen=STEP_RING_CAPACITY)
+
+
+def recent_steps(n: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Last ``n`` (default: all buffered) step records, oldest first."""
+    with _STEP_LOG_LOCK:
+        recs = list(_STEP_RING)
+    if n is None:
+        return recs
+    n = int(n)
+    return recs[-n:] if n > 0 else []
 
 
 _step_log_warned = False
 
 
 def log_step(record: Dict[str, Any]):
-    """Append one JSONL record to the step log. Fills ``v``, ``ts`` and
-    ``seq``; flushes per line so a live tail (or a test) sees every
-    record. No-op when telemetry is off or no path is configured. An
-    unwritable path warns once and drops records — callers invoke this
-    from ``finally`` blocks, and a telemetry failure must never mask the
-    step's real result (or the exception being recorded)."""
+    """Record one step: fills ``v``, ``ts`` and ``seq``, appends to the
+    bounded ring buffer, and — when ``step_log_path`` is configured —
+    appends a JSONL line (flushed per record so a live tail sees every
+    one). No-op when telemetry is off. An unwritable path warns once and
+    drops the DISK copy only — callers invoke this from ``finally``
+    blocks, and a telemetry failure must never mask the step's real
+    result (or the exception being recorded)."""
     global _step_log_file, _step_log_path, _step_seq, _step_log_warned
-    if not step_log_active():
+    if not _enabled:
         return
     path = _flags.get_flag("step_log_path")
     with _STEP_LOG_LOCK:
+        record = dict(record)
+        record.setdefault("v", STEP_LOG_SCHEMA_VERSION)
+        record.setdefault("ts", time.time())  # human-readable anchor
+        record["seq"] = _step_seq
+        _step_seq += 1
+        _STEP_RING.append(record)
+        if not path:
+            return
         try:
             if _step_log_file is None or path != _step_log_path:
                 if _step_log_file is not None:
@@ -457,11 +627,6 @@ def log_step(record: Dict[str, Any]):
                 _step_log_file = open(path, "a")
                 _step_log_path = path
                 _step_log_warned = False
-            record = dict(record)
-            record.setdefault("v", STEP_LOG_SCHEMA_VERSION)
-            record.setdefault("ts", time.time())  # human-readable anchor
-            record["seq"] = _step_seq
-            _step_seq += 1
             # default=str: a numpy scalar (or anything else json chokes
             # on) degrades to its string form instead of raising
             _step_log_file.write(
@@ -471,8 +636,6 @@ def log_step(record: Dict[str, Any]):
             # finally blocks and the step's real exception must win
             if not _step_log_warned:
                 _step_log_warned = True
-                import warnings
-
                 warnings.warn(
                     f"step log write to {path!r} failed; records are "
                     f"being dropped: {e!r}", RuntimeWarning)
@@ -483,6 +646,17 @@ def log_step(record: Dict[str, Any]):
 # ---------------------------------------------------------------------------
 
 _span_seconds: Optional[Histogram] = None
+
+# Per-thread stack of active span names (telemetry-on spans only): the
+# stall watchdog snapshots it at arm time so a stall record says WHERE
+# the thread was ("trainer.step" > "executor.run_step"), not just that
+# it stalled.
+_TLS = threading.local()
+
+
+def span_stack() -> Tuple[str, ...]:
+    """Names of this thread's active telemetry spans, outermost first."""
+    return tuple(getattr(_TLS, "spans", ()))
 
 
 def span(name: str):
@@ -504,6 +678,10 @@ def _timed_span(name: str):
     if _span_seconds is None:
         _span_seconds = histogram(
             "pt_span_seconds", "host span durations by span name")
+    stack = getattr(_TLS, "spans", None)
+    if stack is None:
+        stack = _TLS.spans = []
+    stack.append(name)
     t0 = time.perf_counter()
     with _profiler.record_event(name):
         try:
@@ -511,8 +689,531 @@ def _timed_span(name: str):
         finally:
             _span_seconds.observe(time.perf_counter() - t0,
                                   labels={"span": name})
+            stack.pop()
 
 
-# register the watcher last so the module is fully initialized when the
-# immediate callback fires
+# ---------------------------------------------------------------------------
+# compile reports
+# ---------------------------------------------------------------------------
+
+COMPILE_REPORT_SCHEMA_VERSION = 1
+
+# field name -> (accepted types, required, doc). Cost/memory numbers are
+# null (with source == "estimate") when the jax/backend version exposes
+# no cost_analysis()/memory_analysis(); bump the version on any
+# incompatible change. The doc-coverage test and README both derive from
+# this table.
+COMPILE_REPORT_FIELDS: Dict[str, tuple] = {
+    "v": ((int,), True,
+          "schema version (COMPILE_REPORT_SCHEMA_VERSION)"),
+    "ts": ((float, int), True, "wall-clock unix timestamp of the compile"),
+    "program": ((str,), True, "program id ('program<uid>')"),
+    "program_uid": ((int,), True, "Program._uid of the compiled program"),
+    "cache_key": ((str,), True,
+                  "hash of the executor cache key (program version + "
+                  "feed signature + fetch list)"),
+    "kind": ((str,), True, "'step' (run) or 'window' (run_steps)"),
+    "backend": ((str,), True, "jax backend the program compiled for"),
+    "source": ((str,), True,
+               "'xla' when cost/memory numbers come from the compiled "
+               "executable; 'estimate' when the analysis APIs were "
+               "unavailable and only op-count estimates are present"),
+    "compile_ms": ((float, int, type(None)), True,
+                   "executor-side build time (trace + jit wrap)"),
+    "analysis_ms": ((float, int, type(None)), True,
+                    "AOT lower+compile time of the analysis twin — the "
+                    "closest measure of true XLA compile cost; null "
+                    "when source == 'estimate'"),
+    "flops": ((float, int, type(None)), True,
+              "XLA cost-analysis flop count; null when unavailable"),
+    "bytes_accessed": ((float, int, type(None)), True,
+                       "XLA cost-analysis bytes accessed (HBM traffic "
+                       "estimate); null when unavailable"),
+    "peak_bytes": ((int, type(None)), True,
+                   "argument + output + temp - aliased bytes: the "
+                   "device-memory high-water estimate; null when "
+                   "unavailable"),
+    "argument_bytes": ((int, type(None)), True,
+                       "device bytes of the program's arguments"),
+    "output_bytes": ((int, type(None)), True,
+                     "device bytes of the program's outputs"),
+    "temp_bytes": ((int, type(None)), True,
+                   "XLA temp-buffer bytes (workspace/scratch)"),
+    "alias_bytes": ((int, type(None)), True,
+                    "argument bytes aliased into outputs (donation)"),
+    "generated_code_bytes": ((int, type(None)), True,
+                             "compiled executable code size"),
+    "n_ops": ((int,), True, "Program-IR ops lowered into this XLA "
+                            "program"),
+    "op_histogram": ((dict,), True,
+                     "op type -> count over the lowered block (the "
+                     "op-lowering histogram)"),
+    "strategy": ((str, type(None)), True,
+                 "SPMD strategy id (mesh axes) or null"),
+}
+
+
+def validate_compile_report(rec: Dict[str, Any]):
+    """Raise ValueError unless ``rec`` conforms to COMPILE_REPORT_FIELDS."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"compile report must be a dict, got {type(rec)}")
+    for field, (types, required, _doc) in COMPILE_REPORT_FIELDS.items():
+        if field not in rec:
+            if required:
+                raise ValueError(f"compile report missing field '{field}'")
+            continue
+        if not isinstance(rec[field], types):
+            raise ValueError(
+                f"compile report field '{field}' has type "
+                f"{type(rec[field]).__name__}, expected one of "
+                f"{[t.__name__ for t in types]}")
+    unknown = set(rec) - set(COMPILE_REPORT_FIELDS)
+    if unknown:
+        raise ValueError(
+            f"compile report has unknown fields {sorted(unknown)}")
+    if rec["v"] != COMPILE_REPORT_SCHEMA_VERSION:
+        raise ValueError(
+            f"compile report schema v{rec['v']} != "
+            f"v{COMPILE_REPORT_SCHEMA_VERSION}")
+    if rec["source"] not in ("xla", "estimate"):
+        raise ValueError(
+            f"compile report source {rec['source']!r} not in "
+            f"('xla', 'estimate')")
+
+
+_COMPILE_LOCK = threading.Lock()
+# program id -> latest report; insertion-ordered so eviction drops the
+# program that compiled longest ago
+_COMPILE_REPORTS: Dict[str, Dict[str, Any]] = {}
+MAX_COMPILE_REPORTS = 32
+
+_M_COMPILE_REPORTS = None
+_M_COMPILE_FLOPS = None
+_M_COMPILE_PEAK = None
+_M_COMPILE_SECONDS = None
+
+
+def _compile_instruments():
+    global _M_COMPILE_REPORTS, _M_COMPILE_FLOPS, _M_COMPILE_PEAK
+    global _M_COMPILE_SECONDS
+    if _M_COMPILE_REPORTS is None:
+        _M_COMPILE_REPORTS = counter(
+            "pt_compile_reports_total", "compile reports recorded")
+        _M_COMPILE_FLOPS = gauge(
+            "pt_compile_flops",
+            "XLA cost-analysis flops of the latest compile, by program")
+        _M_COMPILE_PEAK = gauge(
+            "pt_compile_peak_bytes",
+            "device-memory high-water estimate of the latest compile, "
+            "by program")
+        _M_COMPILE_SECONDS = histogram(
+            "pt_compile_seconds",
+            "XLA compile time per fresh executor compile")
+
+
+def compile_reports_active() -> bool:
+    """Executors consult this per cache miss: reports are generated when
+    telemetry is on AND someone can see them (a compile_report_dir is
+    configured or the live endpoint is up). Each report costs one extra
+    AOT lower+compile, so it is never on by accident."""
+    return _enabled and (bool(_flags.get_flag("compile_report_dir"))
+                         or _server is not None)
+
+
+def record_compile_report(report: Dict[str, Any]):
+    """Store a compile report: ring-buffered in memory (the /compile
+    endpoint), mirrored into pt_compile_* instruments, and written as
+    ``<program>-<cache_key>.json`` under the ``compile_report_dir`` flag
+    when set. Never raises — telemetry must not fail a step."""
+    try:
+        report = dict(report)
+        report.setdefault("v", COMPILE_REPORT_SCHEMA_VERSION)
+        report.setdefault("ts", time.time())
+        _compile_instruments()
+        prog = report.get("program", "?")
+        with _COMPILE_LOCK:
+            _COMPILE_REPORTS.pop(prog, None)
+            _COMPILE_REPORTS[prog] = report
+            while len(_COMPILE_REPORTS) > MAX_COMPILE_REPORTS:
+                _COMPILE_REPORTS.pop(next(iter(_COMPILE_REPORTS)))
+        _M_COMPILE_REPORTS.inc()
+        if report.get("flops") is not None:
+            _M_COMPILE_FLOPS.set(report["flops"],
+                                 labels={"program": prog})
+        if report.get("peak_bytes") is not None:
+            _M_COMPILE_PEAK.set(report["peak_bytes"],
+                                labels={"program": prog})
+        ms = report.get("analysis_ms") or report.get("compile_ms")
+        if ms is not None:
+            _M_COMPILE_SECONDS.observe(ms / 1e3)
+        out_dir = _flags.get_flag("compile_report_dir")
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            fname = f"{prog}-{report.get('cache_key', 'nokey')}.json"
+            with open(os.path.join(out_dir, fname), "w") as f:
+                json.dump(report, f, sort_keys=True, indent=1,
+                          default=str)
+    except Exception as e:
+        warnings.warn(f"compile report dropped: {e!r}", RuntimeWarning)
+
+
+def compile_reports() -> Dict[str, Dict[str, Any]]:
+    """Latest compile report per program (insertion order = compile
+    order, oldest first)."""
+    with _COMPILE_LOCK:
+        return {k: dict(v) for k, v in _COMPILE_REPORTS.items()}
+
+
+# ---------------------------------------------------------------------------
+# pre-flight memory estimate
+# ---------------------------------------------------------------------------
+
+def _var_nbytes(shape, dtype, batch: int) -> int:
+    n = 1
+    for d in shape:
+        n *= batch if int(d) < 0 else max(int(d), 1)
+    # np.dtype('bfloat16') raises without ml_dtypes registered; its width
+    # is what matters here
+    itemsize = 2 if str(dtype) == "bfloat16" else __import__(
+        "numpy").dtype(dtype).itemsize
+    return n * itemsize
+
+
+def estimate_memory(program, feed_shapes: Optional[Dict[str, Any]] = None,
+                    budget_bytes: Optional[int] = None) -> Dict[str, Any]:
+    """Static pre-flight device-memory estimate for ``program``: sums
+    declared var shapes in block 0 (``-1`` batch dims resolved from
+    ``feed_shapes``' leading dim, else 1) into parameter / feed /
+    activation byte totals. A LOWER BOUND — XLA temps, donation aliasing
+    and fusion are unknowable before the compile — but params +
+    activations catch the common will-it-OOM case before paying a
+    multi-minute compile for an OOM.
+
+    Returns ``{param_bytes, feed_bytes, activation_bytes, total_bytes,
+    budget_bytes, fits}`` (``fits`` is None when no budget applies, from
+    the ``device_memory_budget_bytes`` flag unless passed here)."""
+    feed_shapes = feed_shapes or {}
+    if budget_bytes is None:
+        budget_bytes = _flags.get_flag("device_memory_budget_bytes")
+    batch = 1
+    for shp in feed_shapes.values():
+        if len(shp) and int(shp[0]) > 0:
+            batch = int(shp[0])
+            break
+    param = feed = act = 0
+    block = program.blocks[0]
+    for name, var in block.vars.items():
+        if var.shape is None or var.dtype is None:
+            continue
+        if name in feed_shapes:
+            nb = _var_nbytes(feed_shapes[name], var.dtype, batch)
+            feed += nb
+        else:
+            nb = _var_nbytes(var.shape, var.dtype, batch)
+            if var.persistable:
+                param += nb
+            else:
+                act += nb
+    total = param + feed + act
+    return {
+        "param_bytes": param,
+        "feed_bytes": feed,
+        "activation_bytes": act,
+        "total_bytes": total,
+        "budget_bytes": int(budget_bytes),
+        "fits": None if not budget_bytes else total <= budget_bytes,
+    }
+
+
+# cached hot value of the device_memory_budget_bytes flag so the
+# executor's pre-compile check is one int compare when no budget is set
+_mem_budget = 0
+
+
+def memory_budget_bytes() -> int:
+    return _mem_budget
+
+
+def _sync_mem_budget(value):
+    global _mem_budget
+    _mem_budget = int(value)
+
+
+def check_memory_budget(program, feed_shapes: Optional[Dict] = None):
+    """Pre-compile budget gate: estimate and warn when over. Returns the
+    estimate (or None when no budget is configured). Never raises."""
+    if _mem_budget <= 0:
+        return None
+    try:
+        est = estimate_memory(program, feed_shapes,
+                              budget_bytes=_mem_budget)
+    except Exception as e:
+        warnings.warn(f"memory pre-flight failed: {e!r}", RuntimeWarning)
+        return None
+    if est["fits"] is False:
+        warnings.warn(
+            f"program{program._uid}: static memory estimate "
+            f"{est['total_bytes']:,} B (params {est['param_bytes']:,} + "
+            f"feeds {est['feed_bytes']:,} + activations "
+            f"{est['activation_bytes']:,}) exceeds the "
+            f"device_memory_budget_bytes flag ({_mem_budget:,} B) — "
+            f"this compile is likely to OOM at run time",
+            RuntimeWarning)
+    return est
+
+
+# ---------------------------------------------------------------------------
+# live endpoint (/metrics /healthz /steps /compile)
+# ---------------------------------------------------------------------------
+
+_server = None
+_server_thread: Optional[threading.Thread] = None
+_server_started_ts = 0.0
+
+
+def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
+    """Start the observability HTTP server on a background daemon thread
+    (idempotent; returns the bound port). ``port=0`` binds an ephemeral
+    port — the test / multi-worker-per-host pattern. Routes:
+
+    - ``/metrics``  Prometheus text exposition of the registry
+    - ``/healthz``  JSON liveness (status, telemetry state, uptime)
+    - ``/steps``    JSON ring buffer of recent step records (``?n=``)
+    - ``/compile``  JSON latest compile report per program
+
+    Binds localhost by default: metrics can carry program names — scrape
+    through a sidecar or port-forward, don't expose it."""
+    global _server, _server_thread, _server_started_ts
+    if _server is not None:
+        return _server.server_address[1]
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    if port is None:
+        port = _flags.get_flag("metrics_port")
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            path, _, query = self.path.partition("?")
+            try:
+                if path == "/metrics":
+                    body = to_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/healthz":
+                    body = json.dumps({
+                        "status": "ok",
+                        "telemetry": _enabled,
+                        "uptime_s": time.time() - _server_started_ts,
+                        "steps_buffered": len(_STEP_RING),
+                        "stalls": len(_STALLS),
+                    }).encode()
+                    ctype = "application/json"
+                elif path == "/steps":
+                    n = None
+                    for part in query.split("&"):
+                        if part.startswith("n="):
+                            n = int(part[2:])
+                    body = json.dumps(recent_steps(n),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif path == "/compile":
+                    body = json.dumps(compile_reports(), sort_keys=True,
+                                      default=str).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+            except Exception as e:  # surface as 500, never kill the thread
+                self.send_error(500, str(e))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # scrapes every few seconds —
+            pass                       # stderr noise helps nobody
+
+    _server = ThreadingHTTPServer((host, int(port)), _Handler)
+    _server.daemon_threads = True
+    _server_started_ts = time.time()
+    _server_thread = threading.Thread(
+        target=_server.serve_forever, name="pt-monitor-http", daemon=True)
+    _server_thread.start()
+    return _server.server_address[1]
+
+
+def server_address() -> Optional[Tuple[str, int]]:
+    return None if _server is None else tuple(_server.server_address[:2])
+
+
+def stop_server():
+    global _server, _server_thread
+    srv, _server = _server, None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    if _server_thread is not None:
+        _server_thread.join(timeout=5)
+        _server_thread = None
+
+
+def _maybe_autostart_server(_value=None):
+    """Flag watcher: bring the server up once `telemetry` is on and
+    `metrics_port` is nonzero, whichever flips last."""
+    port = _flags.get_flag("metrics_port")
+    if _enabled and port > 0 and _server is None:
+        try:
+            serve(port)
+        except OSError as e:
+            warnings.warn(
+                f"metrics server failed to bind port {port}: {e!r}",
+                RuntimeWarning)
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+# ---------------------------------------------------------------------------
+
+STALL_RECORD_SCHEMA_VERSION = 1
+
+_STALLS: collections.deque = collections.deque(maxlen=32)
+_stall_seq = 0
+# each guard's watchdog is its own timer thread: concurrent stalls (one
+# peer death stalls several sites at once) must not share a seq or
+# overwrite each other's flight-recorder dump
+_STALL_LOCK = threading.Lock()
+
+_M_STALLS = None
+
+
+def _stall_counter():
+    global _M_STALLS
+    if _M_STALLS is None:
+        _M_STALLS = counter(
+            "pt_stall_total",
+            "guarded collective sections that exceeded their watchdog "
+            "deadline, by site")
+    return _M_STALLS
+
+
+# cached hot value of stall_timeout_ms (same pattern as `telemetry`)
+_stall_ms = 0
+
+
+def _sync_stall_ms(value):
+    global _stall_ms
+    _stall_ms = int(value)
+
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+def stall_guard(name: str, deadline_ms: Optional[float] = None):
+    """Watchdog context for a blocking collective (barrier, rendezvous,
+    multi-host dispatch). If the body outlives the deadline (the
+    ``stall_timeout_ms`` flag unless given here), a timer thread fires
+    ONCE: ``pt_stall_total{site=name}`` increments, a structured stall
+    record (site, deadline, the arming thread's active span stack, the
+    last step record) is buffered + warned, and — when the
+    ``stall_dump_dir`` flag is set — the flight recorder (stall record,
+    step ring buffer, full metrics snapshot) is dumped to disk. The body
+    is never interrupted: a watchdog that kills a slow-but-alive
+    collective would convert stragglers into crashes.
+
+    Disabled (telemetry off, or no deadline anywhere) this returns a
+    shared nullcontext — one boolean/int check, zero allocations."""
+    if not _enabled:
+        return _NULL_CTX
+    ms = _stall_ms if deadline_ms is None else deadline_ms
+    if ms <= 0:
+        return _NULL_CTX
+    return _StallGuard(name, float(ms))
+
+
+class _StallGuard:
+    __slots__ = ("name", "ms", "_timer")
+
+    def __init__(self, name: str, ms: float):
+        self.name = name
+        self.ms = ms
+
+    def __enter__(self):
+        self._timer = threading.Timer(
+            self.ms / 1e3, _record_stall,
+            args=(self.name, self.ms, threading.current_thread().name,
+                  span_stack()))
+        self._timer.daemon = True
+        self._timer.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._timer.cancel()
+        return False
+
+
+def _record_stall(site: str, deadline_ms: float, thread_name: str,
+                  spans: Tuple[str, ...]):
+    """Runs on the watchdog timer thread. Never raises."""
+    global _stall_seq
+    try:
+        last_steps = recent_steps(1)
+        with _STALL_LOCK:
+            seq = _stall_seq
+            _stall_seq += 1
+        rec = {
+            "v": STALL_RECORD_SCHEMA_VERSION,
+            "ts": time.time(),
+            "seq": seq,
+            "site": site,
+            "deadline_ms": deadline_ms,
+            "thread": thread_name,
+            "span_stack": list(spans),
+            "last_step": last_steps[0] if last_steps else None,
+        }
+        _STALLS.append(rec)
+        _stall_counter().inc(labels={"site": site})
+        warnings.warn(
+            f"stall watchdog: {site!r} exceeded {deadline_ms:.0f} ms "
+            f"(thread {thread_name}, spans {list(spans)}); the section "
+            f"is still blocked", RuntimeWarning)
+        dump_dir = _flags.get_flag("stall_dump_dir")
+        if dump_dir:
+            os.makedirs(dump_dir, exist_ok=True)
+            path = os.path.join(
+                dump_dir, f"stall-{rec['seq']}-{int(rec['ts'])}.json")
+            with open(path, "w") as f:
+                json.dump({
+                    "stall": rec,
+                    "steps": recent_steps(),
+                    "metrics": snapshot(),
+                    "compile_reports": compile_reports(),
+                }, f, sort_keys=True, indent=1, default=str)
+    except Exception as e:
+        try:
+            warnings.warn(f"stall record dropped: {e!r}", RuntimeWarning)
+        except Exception:
+            pass
+
+
+def stalls() -> List[Dict[str, Any]]:
+    """Buffered stall records, oldest first."""
+    return [dict(r) for r in _STALLS]
+
+
+# Eagerly register monitor-owned instruments: a /metrics scrape (or the
+# doc-coverage test) sees the full builtin set even before the first
+# span/stall/compile happens.
+_span_seconds = histogram(
+    "pt_span_seconds", "host span durations by span name")
+_overflow_total()
+_stall_counter()
+_compile_instruments()
+
+# register watchers last so the module is fully initialized when the
+# immediate callbacks fire (env-set flags take effect at import)
 _flags.watch_flag("telemetry", _sync_from_flags)
+_flags.watch_flag("telemetry", _maybe_autostart_server)
+_flags.watch_flag("metrics_port", _maybe_autostart_server)
+_flags.watch_flag("device_memory_budget_bytes", _sync_mem_budget)
+_flags.watch_flag("stall_timeout_ms", _sync_stall_ms)

@@ -191,13 +191,17 @@ def gpipe(
     if data_axis:
         manual |= (set(data_axis) if isinstance(data_axis, (tuple, list))
                    else {data_axis})
-    out = jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(param_specs, mb_spec, mb_spec),
-        out_specs=mb_spec,
-        axis_names=frozenset(manual),
-    )(stage_params, x_m, streams_m)
+    # multi-host dispatch can block inside the call (compile-time
+    # rendezvous, a stage rank that never arrives): watchdog-guarded so
+    # a hung pipeline schedule produces a stall record, not a silent job
+    with _monitor.stall_guard("pipeline.dispatch"):
+        out = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(param_specs, mb_spec, mb_spec),
+            out_specs=mb_spec,
+            axis_names=frozenset(manual),
+        )(stage_params, x_m, streams_m)
     return out.reshape((b,) + x.shape[1:])
 
 

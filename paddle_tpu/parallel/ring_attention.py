@@ -210,7 +210,11 @@ def ring_attention(
         in_specs=tuple(in_specs),
         out_specs=spec,
     )
-    return fn(q, k, v, bias)
+    # multi-host dispatch can block inside the call (compile-time
+    # rendezvous, a peer that never enters the collective): the watchdog
+    # turns that silent hang into a stall record
+    with _monitor.stall_guard("ring_attention.dispatch"):
+        return fn(q, k, v, bias)
 
 
 def reference_attention(q, k, v, causal: bool = False, scale=None):

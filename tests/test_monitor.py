@@ -1,15 +1,18 @@
 """Telemetry plane (paddle_tpu/monitor.py): registry semantics, exporter
 round-trips, disabled-path overhead, span unification, step-log schema,
-and the flags plane's self-documentation contract."""
+label-cardinality cap, quantile summaries, the step ring buffer, the
+profiler's no-native degrade path, metric doc coverage, and the flags
+plane's self-documentation contract."""
 
 import json
+import os
 import tracemalloc
 
 import numpy as np
 import pytest
 
 import paddle_tpu as fluid
-from paddle_tpu import flags, layers, monitor
+from paddle_tpu import flags, layers, monitor, profiler
 
 
 @pytest.fixture(autouse=True)
@@ -99,6 +102,38 @@ def test_disabled_calls_are_inert_and_allocation_free():
     assert not c._cells and not g._cells and not h._cells
 
 
+def test_label_cardinality_cap_collapses_into_overflow_bucket():
+    """A mis-labelled hot-path metric (step index in a label) must not
+    grow registry memory without bound: past MAX_LABEL_SETS distinct
+    label-sets, mutations collapse into one overflow='true' cell, the
+    first drop warns, and every drop counts into
+    pt_metric_label_overflow_total."""
+    monitor.enable()
+    c = monitor.counter("t_card_c", "capped counter")
+    with pytest.warns(RuntimeWarning, match="label-sets"):
+        for i in range(monitor.MAX_LABEL_SETS + 10):
+            c.inc(labels={"i": i})
+    # the capped cells + exactly one overflow cell
+    assert len(c._cells) == monitor.MAX_LABEL_SETS + 1
+    assert c.value(labels={"overflow": "true"}) == 10
+    assert monitor.counter("pt_metric_label_overflow_total").value(
+        labels={"metric": "t_card_c"}) == 10
+    # existing label-sets keep mutating normally past the cap
+    c.inc(labels={"i": 0})
+    assert c.value(labels={"i": 0}) == 2
+
+    # same contract for gauges and histograms
+    g = monitor.gauge("t_card_g", "capped gauge")
+    h = monitor.histogram("t_card_h", "capped hist", buckets=(1.0,))
+    with pytest.warns(RuntimeWarning, match="label-sets"):
+        for i in range(monitor.MAX_LABEL_SETS + 3):
+            g.set(i, labels={"i": i})
+            h.observe(0.5, labels={"i": i})
+    assert len(g._cells) == monitor.MAX_LABEL_SETS + 1
+    assert len(h._cells) == monitor.MAX_LABEL_SETS + 1
+    assert h.count(labels={"overflow": "true"}) == 3
+
+
 def test_runtime_flag_flip_takes_effect_immediately():
     c = monitor.counter("t_flip", "flip")
     c.inc()
@@ -167,6 +202,36 @@ def test_dump_metrics_round_trips_prometheus_and_json(tmp_path):
 def test_bad_format_raises():
     with pytest.raises(ValueError):
         monitor.dump_metrics(fmt="xml")
+
+
+def test_histogram_quantile_summaries_in_json_and_prometheus():
+    """p50/p95/p99 ride to_json and the Prometheus text as _p50/_p95/_p99
+    samples, so latency tails are readable without a Prometheus server
+    running histogram_quantile for you."""
+    monitor.enable()
+    h = monitor.histogram("t_q_h", "latencies", buckets=(1.0, 2.0, 4.0))
+    for v in [0.5] * 50 + [1.5] * 40 + [3.0] * 10:
+        h.observe(v)
+    # linear interpolation inside the target bucket
+    assert h.quantile(0.50) == pytest.approx(1.0)
+    assert h.quantile(0.95) == pytest.approx(3.0)
+    assert h.quantile(0.99) == pytest.approx(3.8)
+    assert h.quantile(0.5, labels={"no": "cell"}) is None
+
+    cell = json.loads(monitor.to_json())["t_q_h"]["values"][0]
+    assert cell["p50"] == pytest.approx(1.0)
+    assert cell["p95"] == pytest.approx(3.0)
+    assert cell["p99"] == pytest.approx(3.8)
+
+    prom = _parse_prometheus(monitor.dump_metrics(fmt="prometheus"))
+    assert prom["t_q_h_p50"] == pytest.approx(1.0)
+    assert prom["t_q_h_p95"] == pytest.approx(3.0)
+    assert prom["t_q_h_p99"] == pytest.approx(3.8)
+
+    # +Inf-bucket observations clamp to the top finite bound
+    h2 = monitor.histogram("t_q_inf", "h", buckets=(1.0,))
+    h2.observe(50.0)
+    assert h2.quantile(0.99) == 1.0
 
 
 # --------------------------------------------------------------------------
@@ -240,8 +305,25 @@ def test_log_step_unwritable_path_warns_once_never_raises(tmp_path):
 def test_log_step_noop_without_path_or_telemetry(tmp_path):
     monitor.log_step({"kind": "step"})  # no telemetry: no error, no file
     flags.set_flags({"telemetry": True})
-    monitor.log_step({"kind": "step"})  # no path: still a no-op
+    monitor.log_step({"kind": "step"})  # no path: rings, writes nothing
     assert not monitor.step_log_active()
+    assert len(monitor.recent_steps()) == 1  # ring still fed
+
+
+def test_step_ring_buffer_is_bounded_and_ordered():
+    monitor.enable()
+    n = monitor.STEP_RING_CAPACITY
+    for i in range(n + 10):
+        monitor.log_step({"kind": "step", "step": i})
+    recs = monitor.recent_steps()
+    assert len(recs) == n  # the bound IS the memory contract
+    assert recs[0]["step"] == 10 and recs[-1]["step"] == n + 9
+    assert [r["seq"] for r in recs] == list(range(10, n + 10))
+    assert monitor.recent_steps(5) == recs[-5:]
+    assert monitor.recent_steps(0) == []  # not the recs[-0:] full dump
+    assert monitor.recent_steps(-3) == []
+    monitor.reset()
+    assert monitor.recent_steps() == []
 
 
 # --------------------------------------------------------------------------
@@ -328,3 +410,96 @@ def test_mnist_three_step_train_emits_valid_step_log(tmp_path):
     assert json.loads(monitor.dump_metrics(fmt="json"))
     assert "pt_executor_cache_hits_total 2.0" in monitor.dump_metrics(
         fmt="prometheus")
+
+
+# --------------------------------------------------------------------------
+# profiler degrade path (satellite): no native collector, no crash
+# --------------------------------------------------------------------------
+
+def test_profiler_degrades_cleanly_without_native(tmp_path, monkeypatch):
+    """With the C++ profiler unavailable, `with profiler.profiler(...)`
+    must be a structural no-op: no chrome-trace file, no crash, and
+    monitor.span events still round-trip into pt_span_seconds."""
+    from paddle_tpu import native
+
+    monkeypatch.setattr(native, "available", lambda: False)
+    monitor.enable()
+    path = tmp_path / "prof"
+    with profiler.profiler(profile_path=str(path)):
+        with monitor.span("degrade.scope"):
+            pass
+        with profiler.record_event("raw.event"):  # host span: plain yield
+            pass
+    assert not path.with_suffix(".json").exists()
+    assert not (tmp_path / "prof.json").exists()
+    # telemetry half of the unified span still recorded
+    assert monitor.histogram("pt_span_seconds").count(
+        labels={"span": "degrade.scope"}) == 1
+    # start/stop entry points take the same degrade path
+    profiler.start_profiler()
+    profiler.stop_profiler(profile_path=str(tmp_path / "prof2"))
+    assert not (tmp_path / "prof2.json").exists()
+
+
+# --------------------------------------------------------------------------
+# metric doc coverage (satellite): every builtin instrument documented,
+# README's Observability table complete
+# --------------------------------------------------------------------------
+
+def test_every_builtin_metric_has_doc_and_readme_entry():
+    # importing the instrumented modules registers their instruments
+    import paddle_tpu.contrib.trainer  # noqa: F401
+    import paddle_tpu.core.interp  # noqa: F401
+    import paddle_tpu.executor  # noqa: F401
+    import paddle_tpu.incubate.fleet.fleet_base  # noqa: F401
+    import paddle_tpu.parallel.pipeline  # noqa: F401
+    import paddle_tpu.parallel.ring_attention  # noqa: F401
+
+    snap = monitor.snapshot()
+    builtin = {n: m for n, m in snap.items() if n.startswith("pt_")}
+    assert len(builtin) >= 25, sorted(builtin)
+    readme = open(os.path.join(os.path.dirname(fluid.__file__), "..",
+                               "README.md")).read()
+    for name, m in sorted(builtin.items()):
+        assert m["doc"].strip(), f"metric '{name}' has no doc string"
+        assert name in readme, (
+            f"metric '{name}' missing from README's Observability "
+            f"metrics table")
+
+
+# --------------------------------------------------------------------------
+# executor hot path with telemetry off: the one-boolean-check contract
+# --------------------------------------------------------------------------
+
+def test_executor_run_disabled_path_allocates_nothing_in_monitor():
+    """The PR-2 instrumentation (ring buffer, compile reports, budget
+    pre-flight) must not add allocations to Executor.run while telemetry
+    is off — same contract the raw instruments honor."""
+    assert not monitor.enabled()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"x": np.ones((2, 4), np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):  # warm the compile cache + lazy interp state
+            exe.run(main, feed=feed, fetch_list=[y])
+        n_runs = 30
+        tracemalloc.start()
+        base = tracemalloc.take_snapshot()
+        for _ in range(n_runs):
+            exe.run(main, feed=feed, fetch_list=[y])
+        snap = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+    stats = snap.compare_to(base, "filename")
+    grew = sum(s.size_diff for s in stats
+               if s.traceback[0].filename.endswith("monitor.py")
+               and s.size_diff > 0)
+    # per-run allocations would show as >= n_runs * 16B growth; allow
+    # constant interpreter noise only
+    assert grew < n_runs * 16, (
+        f"disabled Executor.run allocated {grew}B in monitor.py over "
+        f"{n_runs} runs")
