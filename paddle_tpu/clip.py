@@ -38,10 +38,23 @@ class GradientClipByNorm(BaseGradientClipAttr):
 
 
 class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    """Scale all gradients so their joint L2 norm stays under
+    ``clip_norm``. The pre-clip global norm and the applied scale are
+    registered as numerics-plane aux vars (numerics.py), so with the
+    ``telemetry`` + ``numerics`` flags on the executor exports
+    ``pt_grad_global_norm`` / ``pt_grad_clip_ratio`` /
+    ``pt_grad_clips_total`` from the in-graph values — the post-clip
+    norm is ``global_norm * scale`` by construction."""
+
     def __init__(self, clip_norm):
         self.clip_norm = float(clip_norm)
+        # var names of the most recent process() call (one per program
+        # build), for tests/debugging
+        self.global_norm_name = None
+        self.scale_name = None
 
     def process(self, params_grads):
+        from paddle_tpu import numerics
         from paddle_tpu.layer_helper import LayerHelper
         from paddle_tpu.layers import nn, tensor
 
@@ -62,6 +75,12 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         scale = nn.elementwise_div(
             clip_v, nn.elementwise_max(global_norm, clip_v)
         )
+        program = helper.main_program
+        numerics.register_aux(program, "grad_global_norm",
+                              global_norm.name)
+        numerics.register_aux(program, "grad_clip_scale", scale.name)
+        self.global_norm_name = global_norm.name
+        self.scale_name = scale.name
         return [
             (p, nn.elementwise_mul(g, scale) if g is not None else None)
             for p, g in params_grads

@@ -18,6 +18,7 @@ import numpy as np
 
 from paddle_tpu import io as _io
 from paddle_tpu import monitor as _monitor
+from paddle_tpu import numerics as _numerics
 from paddle_tpu.data_feeder import DataFeeder
 from paddle_tpu.executor import Executor, Scope, scope_guard
 from paddle_tpu.framework import Program, program_guard
@@ -102,6 +103,13 @@ class Trainer:
             self.loss = self.train_outputs[0]
             self.test_program = self.main_program.clone(for_test=True)
             optimizer_func().minimize(self.loss)
+        if _numerics.active():
+            # numerics plane on at build time: instrument the train
+            # program so every trainer step feeds tensor stats + NaN
+            # provenance (filtered by the numerics_vars flag)
+            from paddle_tpu import passes as _passes
+
+            _passes.apply_pass("instrument_numerics", self.main_program)
         self.exe = Executor(place)
 
         self._run_program = self.main_program

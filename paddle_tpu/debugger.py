@@ -43,14 +43,44 @@ def _compile_report_lines(program: Program) -> list:
     ]
 
 
+def _numerics_lines(program: Program):
+    """(header lines, {op idx -> marker}) from the numerics plane's
+    latest NaN/Inf provenance record for this program (if any)."""
+    from paddle_tpu import numerics
+
+    rec = numerics.provenance_for(program._uid)
+    if rec is None:
+        return [], {}
+    step = rec.get("nan_step")
+    step = rec.get("step") if step is None else step
+    header = [
+        f"numerics provenance (v{rec.get('v')}): first non-finite at "
+        f"op [{rec.get('op_idx')}] {rec.get('op_type')} -> "
+        f"'{rec.get('var')}' (step {step}, "
+        f"nonfinite={rec.get('nonfinite'):.0f}, "
+        f"maxabs={rec.get('maxabs'):.3g})",
+    ]
+    marks = {rec.get("op_idx"): "   !! first non-finite "
+                                f"(var {rec.get('var')}, step {step})"}
+    return header, marks
+
+
 def pprint_program(program: Program, with_shapes: bool = True,
-                   with_compile_report: bool = True) -> str:
+                   with_compile_report: bool = True,
+                   with_numerics: bool = True) -> str:
     """Readable multi-block listing of a Program's vars and ops,
     prefixed with the latest compile-report annotation when telemetry
-    recorded one (``with_compile_report=False`` opts out)."""
+    recorded one (``with_compile_report=False`` opts out) and the latest
+    NaN/Inf provenance record when the numerics plane holds one — the
+    offending op line is marked inline (``with_numerics=False`` opts
+    out)."""
     lines = []
     if with_compile_report:
         lines.extend(_compile_report_lines(program))
+    marks = {}
+    if with_numerics:
+        header, marks = _numerics_lines(program)
+        lines.extend(header)
     for block in program.blocks:
         lines.append(f"block {block.idx}:")
         for name, var in sorted(block.vars.items()):
@@ -67,7 +97,8 @@ def pprint_program(program: Program, with_shapes: bool = True,
                 f"{k}={v}" for k, v in op.inputs.items() if v)
             outs = ", ".join(
                 f"{k}={v}" for k, v in op.outputs.items() if v)
-            lines.append(f"  [{i}] {op.type}({ins}) -> {outs}")
+            mark = marks.get(i, "") if block.idx == 0 else ""
+            lines.append(f"  [{i}] {op.type}({ins}) -> {outs}{mark}")
     return "\n".join(lines)
 
 

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu import monitor as _monitor
+from paddle_tpu import numerics as _numerics
 from paddle_tpu.core import lowering
 from paddle_tpu.framework import (
     CPUPlace,
@@ -189,6 +190,14 @@ class Executor:
             arr = np.asarray(v) if not isinstance(v, jax.Array) else v
             feed_vals[k] = arr
 
+        # Device-side numerics (numerics.py): an instrumented program's
+        # stats bundle rides the SAME compiled step as one extra fetch,
+        # decoded after the run on sampled steps. Resolved before the
+        # cache key — plan attachment bumps the program version.
+        nplan = _numerics.plan_for(program) if _numerics.active() else None
+        run_fetch_names = fetch_names if nplan is None else (
+            fetch_names + [nplan.bundle_var])
+
         sig = tuple(
             (k, tuple(np.shape(v)), str(jnp.result_type(v))) for k, v in feed_vals.items()
         )
@@ -198,12 +207,12 @@ class Executor:
             getattr(program, "_amp", False),
             compiled._uid if compiled is not None else 0,
             sig,
-            tuple(fetch_names),
+            tuple(run_fetch_names),
             scope._uid,
         )
         def build():
             return self._compile(
-                program, compiled, feed_names, fetch_names, scope
+                program, compiled, feed_names, run_fetch_names, scope
             )
 
         if (tele and _monitor.memory_budget_bytes() > 0
@@ -288,8 +297,20 @@ class Executor:
                 except Exception:
                     self._drop_donated(scope, lowered)
                     raise
-            return self._commit(scope, fetch_names, fetches, new_state,
-                                return_numpy, rec)
+            bundle = None
+            if nplan is not None:
+                bundle, fetches = fetches[-1], fetches[:-1]
+            try:
+                return self._commit(scope, fetch_names, fetches, new_state,
+                                    return_numpy, rec)
+            finally:
+                # decoded even when check_nan_inf raises — the provenance
+                # record is most valuable exactly then
+                if bundle is not None and _numerics.should_sample(step_idx):
+                    summary = _numerics.decode(program, nplan, bundle,
+                                               step_idx, kind="step")
+                    if rec is not None:
+                        rec["numerics"] = summary
         finally:
             # logged even when the step raises (NaN scan, device/runtime
             # error): the crashed step's record is the one an operator
@@ -337,6 +358,16 @@ class Executor:
             f.name if isinstance(f, Variable) else str(f) for f in fetch_list
         ]
         feed_names = sorted(feed_list[0])
+        from paddle_tpu import flags as _flags_mod
+
+        # Per-step in-graph finiteness tracking (core/lowering.py): the
+        # compiled window carries the index of the first bad step, so a
+        # failure names the step, not just the window. Part of the cache
+        # key — flipping the flag compiles the other variant.
+        nan_track = bool(_flags_mod.get_flag("check_nan_inf"))
+        nplan = _numerics.plan_for(program) if _numerics.active() else None
+        run_fetch_names = fetch_names if nplan is None else (
+            fetch_names + [nplan.bundle_var])
         # Stacking device_puts every feed; cache by array IDENTITY so a
         # repeated feed_list (the bench window pattern) stages once. The
         # cache only engages when every feed is IMMUTABLE — a jax.Array,
@@ -380,12 +411,13 @@ class Executor:
         key = (
             "multi", program._uid, program.version,
             getattr(program, "_amp", False), len(feed_list), sig,
-            tuple(fetch_names), scope._uid,
+            tuple(run_fetch_names), scope._uid, nan_track,
         )
         def build():
             lowered = lowering.lower_block(program, 0, feed_names,
-                                           fetch_names)
-            return (lowering.jit_lowered_multi(lowered, len(feed_list)),
+                                           run_fetch_names)
+            return (lowering.jit_lowered_multi(lowered, len(feed_list),
+                                               track_nonfinite=nan_track),
                     lowered)
 
         if (tele and _monitor.memory_budget_bytes() > 0
@@ -428,19 +460,44 @@ class Executor:
                     "nan_check": None,
                     "strategy": None,
                 }
-        # note: under check_nan_inf the scan here is window-level (last
-        # fetch + final state), not per-step — per-step scans would
-        # defeat the whole point of the compiled loop
+        # under check_nan_inf the window tracks per-step finiteness
+        # IN-GRAPH (track_nonfinite): the compiled loop stays one
+        # dispatch, yet a failure names the exact step inside it
         try:
+            first_bad = None
             with _monitor.span("executor.run_window"):
                 try:
-                    fetches, new_state = fn(state, stacked, base_key,
-                                            np.uint32(start), int(steps))
+                    if nan_track:
+                        fetches, new_state, first_bad = fn(
+                            state, stacked, base_key, np.uint32(start),
+                            int(steps))
+                    else:
+                        fetches, new_state = fn(state, stacked, base_key,
+                                                np.uint32(start),
+                                                int(steps))
                 except Exception:
                     self._drop_donated(scope, lowered)
                     raise
-            return self._commit(scope, fetch_names, fetches, new_state,
-                                return_numpy, rec)
+            bundle = None
+            if nplan is not None:
+                bundle, fetches = fetches[-1], fetches[:-1]
+            try:
+                return self._commit(scope, fetch_names, fetches, new_state,
+                                    return_numpy, rec,
+                                    nan_first_bad=first_bad,
+                                    window=(start, int(steps)))
+            finally:
+                if bundle is not None and _numerics.should_sample_window(
+                        start, int(steps)):
+                    # the bundle holds the LAST step's stats; nan_step
+                    # (when the in-graph tracker fired) names the first
+                    # bad step of the window
+                    last = start + int(steps) - 1
+                    summary = _numerics.decode(
+                        program, nplan, bundle, last, kind="window",
+                        nan_step=rec.get("nan_step") if rec else None)
+                    if rec is not None:
+                        rec["numerics"] = summary
         finally:
             # logged even when the window raises (see run())
             if rec is not None:
@@ -515,7 +572,7 @@ class Executor:
                 _M_DONATED_DROPS.inc()
 
     def _commit(self, scope, fetch_names, fetches, new_state,
-                return_numpy, rec=None):
+                return_numpy, rec=None, nan_first_bad=None, window=None):
         from paddle_tpu import flags as _flags
 
         if _flags.get_flag("benchmark"):
@@ -532,15 +589,33 @@ class Executor:
         elif _monitor.enabled():
             _M_FETCH_BYTES.inc(_sum_nbytes(fetches))
         if _flags.get_flag("check_nan_inf"):
-            try:
-                self._check_nan_inf(fetch_names, fetches, new_state)
-            except FloatingPointError:
-                _M_NAN_FAILS.inc()
+            if nan_first_bad is not None and window is not None:
+                # compiled window: the in-graph tracker names the FIRST
+                # failing step (jit_lowered_multi track_nonfinite)
+                start, steps = window
+                idx = int(np.asarray(nan_first_bad))
+                if idx < steps:
+                    _M_NAN_FAILS.inc()
+                    if rec is not None:
+                        rec["nan_check"] = "fail"
+                        rec["nan_step"] = start + idx
+                    raise FloatingPointError(
+                        f"check_nan_inf: step {start + idx} (index {idx} "
+                        f"of this {steps}-step compiled window) produced "
+                        f"non-finite values (set flag 'check_nan_inf' to "
+                        f"False to disable)")
                 if rec is not None:
-                    rec["nan_check"] = "fail"
-                raise
-            if rec is not None:
-                rec["nan_check"] = "ok"
+                    rec["nan_check"] = "ok"
+            else:
+                try:
+                    self._check_nan_inf(fetch_names, fetches, new_state)
+                except FloatingPointError:
+                    _M_NAN_FAILS.inc()
+                    if rec is not None:
+                        rec["nan_check"] = "fail"
+                    raise
+                if rec is not None:
+                    rec["nan_check"] = "ok"
         if return_numpy:
             fetches = [np.asarray(x) for x in fetches]
         return fetches

@@ -63,6 +63,19 @@ _DEFS: Dict[str, tuple] = {
     # on a stall, also dump the flight recorder (step ring buffer +
     # metrics snapshot + stall record) as JSON into this directory
     "stall_dump_dir": (str, "", "flight-recorder dump dir on stall"),
+    # device-side numerics plane (numerics.py): executors fetch + decode
+    # the in-graph tensor-stats bundle of instrumented programs into
+    # pt_tensor_* / pt_nonfinite_* instruments and NaN-provenance
+    # records. Needs `telemetry`; off = the one-boolean-check hot path.
+    "numerics": (bool, False, "decode in-graph tensor-stats bundles"),
+    # sample the numerics bundle every N executor steps (the stats are
+    # computed on device every step either way — sampling bounds the
+    # device->host transfer + decode cost); 1 = every step
+    "numerics_every_n_steps": (int, 1, "numerics decode sampling period"),
+    # comma-separated fnmatch patterns selecting which vars the
+    # instrument_numerics pass instruments (e.g. '*@GRAD,fc_*'); empty =
+    # every float activation/gradient/parameter
+    "numerics_vars": (str, "", "var-name filter for instrument_numerics"),
 }
 
 _values: Dict[str, Any] = {}

@@ -391,6 +391,13 @@ def reset():
         _COMPILE_REPORTS.clear()
     _STALLS.clear()
     _stall_seq = 0
+    import sys
+
+    # numerics rides the same test-isolation hook; lazy so importing
+    # monitor alone never pulls the numerics plane in
+    numerics = sys.modules.get("paddle_tpu.numerics")
+    if numerics is not None:
+        numerics.reset()
 
 
 def snapshot() -> Dict[str, Any]:
@@ -533,6 +540,13 @@ STEP_LOG_FIELDS: Dict[str, tuple] = {
     "fetch_bytes": ((int,), True, "total bytes across fetch arrays"),
     "nan_check": ((str, type(None)), True,
                   "'ok'/'fail' when check_nan_inf ran, else null"),
+    "nan_step": ((int,), False,
+                 "GLOBAL index of the first non-finite step inside a "
+                 "compiled window (only on a window nan_check fail)"),
+    "numerics": ((dict,), False,
+                 "sampled numerics-bundle summary (numerics.py): "
+                 "instrumented var count, non-finite var count, "
+                 "first_bad {op, op_type, var} or null, aux gauges"),
     "strategy": ((str, type(None)), True,
                  "SPMD strategy id (mesh axes) or null for plain runs"),
 }
@@ -980,6 +994,8 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
     - ``/healthz``  JSON liveness (status, telemetry state, uptime)
     - ``/steps``    JSON ring buffer of recent step records (``?n=``)
     - ``/compile``  JSON latest compile report per program
+    - ``/numerics`` JSON numerics plane: NaN/Inf provenance records +
+      latest decoded tensor stats per program (numerics.py)
 
     Binds localhost by default: metrics can carry program names — scrape
     through a sidecar or port-forward, don't expose it."""
@@ -1017,6 +1033,13 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
                     ctype = "application/json"
                 elif path == "/compile":
                     body = json.dumps(compile_reports(), sort_keys=True,
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif path == "/numerics":
+                    # lazy import: numerics.py imports monitor.py
+                    from paddle_tpu import numerics as _numerics
+
+                    body = json.dumps(_numerics.summary(), sort_keys=True,
                                       default=str).encode()
                     ctype = "application/json"
                 else:

@@ -128,11 +128,13 @@ class Optimizer:
                     f"dense grads only would under-clip); use "
                     f"is_sparse=False"
                 )
+        pre_clip_dense = list(dense)
         dense = clip_mod.append_gradient_clip_ops(dense)
         dense = reg_mod.append_regularization_ops(
             dense, self.regularization
         )
         params_grads = dense + sparse
+        self._maybe_instrument_grad_norm(prog, pre_clip_dense)
 
         self._create_accumulators(block, [p for p, _ in params_grads])
         n_before = len(block.ops)
@@ -150,6 +152,41 @@ class Optimizer:
             f"SGD/Momentum/Adam for is_sparse=True embeddings, or build "
             f"the embedding with is_sparse=False"
         )
+
+    @staticmethod
+    def _maybe_instrument_grad_norm(prog, dense):
+        """Numerics-plane grad-norm instrument: with the ``numerics``
+        flag on at graph-BUILD time (and no GradientClipByGlobalNorm
+        already exporting the norm), append a global-norm reduction over
+        the PRE-clip, pre-decay dense gradients — the same semantics the
+        clip path exports, so ``pt_grad_global_norm`` always means the
+        raw-gradient norm — and register it as an aux var. Flag-gated at
+        build so default-off programs carry zero extra ops; unused the
+        ops are DCE'd by XLA anyway."""
+        from paddle_tpu import flags as _flags
+
+        if not _flags.get_flag("numerics"):
+            return
+        from paddle_tpu import numerics
+
+        if any(k == "grad_global_norm"
+               for k, _ in getattr(prog, "_numerics_aux", ())):
+            return
+        grads = [g for _, g in dense if g is not None]
+        if not grads:
+            return
+        from paddle_tpu.layer_helper import LayerHelper
+        from paddle_tpu.layers import nn
+
+        helper = LayerHelper("grad_norm_instrument")
+        sq = []
+        for g in grads:
+            out = helper.create_variable_for_type_inference(dtype=g.dtype)
+            helper.append_op("squared_l2_norm", inputs={"X": g},
+                             outputs={"Out": out})
+            sq.append(out)
+        norm = nn.sqrt(nn.sums(sq))
+        numerics.register_aux(prog, "grad_global_norm", norm.name)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):

@@ -106,6 +106,22 @@ def _amp(program, scope=None, **kw):
     return program
 
 
+@register_pass("instrument_numerics")
+def _instrument_numerics(program, scope=None, vars=None, histogram_bins=0,
+                         **kw):
+    """Append the in-graph tensor-stats bundle (numerics.py): cheap
+    on-device reductions (non-finite count, max-abs, rms, optional
+    log2-magnitude histogram) over selected op outputs — activations,
+    gradients, parameters — fetched by the executor as ONE auxiliary
+    array per sampled step and decoded into pt_tensor_* instruments and
+    NaN-provenance records. Apply after the program is fully built
+    (minimize/clip/AMP included)."""
+    from paddle_tpu import numerics
+
+    numerics.instrument(program, vars=vars, histogram_bins=histogram_bins)
+    return program
+
+
 @register_pass("inference_prune")
 def _inference_prune(program, scope=None, targets=None, feeds=None, **kw):
     """Prune to the inference subgraph reaching ``targets`` (io.py's

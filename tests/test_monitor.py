@@ -285,6 +285,17 @@ def test_validate_step_record_rejects_bad_records():
         monitor.validate_step_record(dict(good, bogus=1))
     with pytest.raises(ValueError, match="schema"):
         monitor.validate_step_record(dict(good, v=999))
+    # PR-3 optional fields: the numerics summary and a window's
+    # first-bad-step index validate when present, stay optional when not
+    monitor.validate_step_record(dict(
+        good, nan_check="fail", nan_step=7,
+        numerics={"vars": 3, "nonfinite_vars": 1,
+                  "first_bad": {"op": 2, "op_type": "elementwise_sub",
+                                "var": "t"}}))
+    with pytest.raises(ValueError, match="type"):
+        monitor.validate_step_record(dict(good, nan_step="seven"))
+    with pytest.raises(ValueError, match="type"):
+        monitor.validate_step_record(dict(good, numerics="not-a-dict"))
 
 
 def test_log_step_unwritable_path_warns_once_never_raises(tmp_path):
@@ -342,6 +353,14 @@ def test_describe_flags_covers_every_flag_with_docs():
         assert row["value"] == flags.get_flag(row["name"])
     by_name = {r["name"]: r for r in table}
     assert by_name["telemetry"]["default"] is False
+    # the numerics plane's flags ride the same self-documentation
+    # contract: present, typed, defaulted off/every-step/unfiltered
+    assert by_name["numerics"]["type"] == "bool"
+    assert by_name["numerics"]["default"] is False
+    assert by_name["numerics_every_n_steps"]["type"] == "int"
+    assert by_name["numerics_every_n_steps"]["default"] == 1
+    assert by_name["numerics_vars"]["type"] == "str"
+    assert by_name["numerics_vars"]["default"] == ""
 
 
 def test_watch_flag_fires_immediately_and_on_change():
