@@ -193,6 +193,7 @@ class Trainer:
         event_handler: Optional[Callable] = None,
         reader: Optional[Callable] = None,
         feed_order: Optional[Sequence[str]] = None,
+        log_time_attribution: bool = True,
     ):
         if reader is None or feed_order is None:
             raise ValueError(
@@ -240,6 +241,21 @@ class Trainer:
                     break
                 handler(EndEpochEvent(epoch))
                 _M_EPOCHS.inc()
+                if log_time_attribution and _monitor.enabled():
+                    # the time-attribution plane's answer to "why was
+                    # this epoch slow": which side of the machine the
+                    # last window of steps spent its wall time on
+                    # (None unless the step_phases plane is producing
+                    # verdicts; log_time_attribution=False silences it)
+                    b = _monitor.boundedness()
+                    if b is not None:
+                        s = b["shares"]
+                        print(
+                            f"[trainer] epoch {epoch} time attribution: "
+                            f"{b['verdict']} (input {s['input']:.0%}, "
+                            f"dispatch {s['dispatch']:.0%}, device "
+                            f"{s['device']:.0%} over last {b['steps']} "
+                            f"steps)")
                 if (
                     self._ckpt_cfg is not None
                     and (epoch + 1) % self._ckpt_cfg.epoch_interval == 0

@@ -8,10 +8,12 @@ int array carries true lengths (SURVEY.md section 5 static-shape discipline).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu import monitor as _monitor
 from paddle_tpu.framework import Variable
 
 
@@ -35,7 +37,20 @@ class DataFeeder:
     def feed(self, iterable) -> Dict[str, np.ndarray]:
         """iterable: list of samples; each sample is a tuple aligned with
         feed_list. Returns {name: batched ndarray} (+ ``name_len`` for fields
-        declared in ``pad_to``)."""
+        declared in ``pad_to``).
+
+        With telemetry on, the batch-assembly time feeds
+        ``pt_feed_build_seconds`` and the boundedness verdict's input
+        score — batching on the step loop's critical path is
+        input-pipeline time even though nothing 'waits'."""
+        if not _monitor.enabled():
+            return self._feed(iterable)
+        t0 = time.perf_counter()
+        out = self._feed(iterable)
+        _monitor.feed_build(time.perf_counter() - t0)
+        return out
+
+    def _feed(self, iterable) -> Dict[str, np.ndarray]:
         columns: List[List] = [[] for _ in self.feed_vars]
         for sample in iterable:
             if len(sample) != len(self.feed_vars):

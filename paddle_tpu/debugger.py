@@ -43,6 +43,34 @@ def _compile_report_lines(program: Program) -> list:
     ]
 
 
+def _time_attribution_lines() -> list:
+    """Annotation from the time-attribution plane: the latest step
+    record's phase breakdown plus the rolling boundedness verdict. Not
+    program-keyed (step records aren't) — it describes the most recent
+    executor step, which during single-program debugging is the one
+    being inspected."""
+    from paddle_tpu import monitor
+
+    recs = monitor.recent_steps(1)
+    phases = recs[0].get("phases") if recs else None
+    bound = monitor.boundedness()
+    if phases is None and bound is None:
+        return []
+    lines = []
+    if phases is not None:
+        lines.append(
+            "time attribution (last step): " + " ".join(
+                f"{k}={phases[k]:.2f}ms" for k in
+                ("feed", "dispatch", "device", "fetch") if k in phases))
+    if bound is not None:
+        s = bound["shares"]
+        lines.append(
+            f"  boundedness: {bound['verdict']} over last "
+            f"{bound['steps']} steps (input {s['input']:.0%} dispatch "
+            f"{s['dispatch']:.0%} device {s['device']:.0%})")
+    return lines
+
+
 def _numerics_lines(program: Program):
     """(header lines, {op idx -> marker}) from the numerics plane's
     latest NaN/Inf provenance record for this program (if any)."""
@@ -67,16 +95,21 @@ def _numerics_lines(program: Program):
 
 def pprint_program(program: Program, with_shapes: bool = True,
                    with_compile_report: bool = True,
-                   with_numerics: bool = True) -> str:
+                   with_numerics: bool = True,
+                   with_timeline: bool = True) -> str:
     """Readable multi-block listing of a Program's vars and ops,
     prefixed with the latest compile-report annotation when telemetry
-    recorded one (``with_compile_report=False`` opts out) and the latest
+    recorded one (``with_compile_report=False`` opts out), the latest
     NaN/Inf provenance record when the numerics plane holds one — the
     offending op line is marked inline (``with_numerics=False`` opts
+    out) — and the latest step's phase breakdown + boundedness verdict
+    from the time-attribution plane (``with_timeline=False`` opts
     out)."""
     lines = []
     if with_compile_report:
         lines.extend(_compile_report_lines(program))
+    if with_timeline:
+        lines.extend(_time_attribution_lines())
     marks = {}
     if with_numerics:
         header, marks = _numerics_lines(program)

@@ -239,8 +239,37 @@ class Executor:
         step_idx = self._step
         self._step += 1
 
+        # Phase attribution timestamps (perf_counter; 0.0 = not reached,
+        # so a step that failed before commit logs a record without
+        # phases — truncated phase durations would skew the verdict
+        # window). Phases: feed = host->device staging, dispatch =
+        # Python + tracing overhead (both segments around the staged
+        # feed), device = delta to block_until_ready, fetch =
+        # device->host + decode in _commit. Gated separately from
+        # `tele`: the device phase costs a per-step sync, and the
+        # step_phases flag lets metrics-only telemetry keep async
+        # dispatch.
+        ph = tele and _monitor.phases_active()
+        t_f0 = t_f1 = t_c1 = t_b1 = t_x0 = t_x1 = 0.0
+        if ph:
+            t_f0 = time.perf_counter()
         if compiled is not None:
             state, feed_vals = compiled.shard_inputs(state, feed_vals)
+        if ph:
+            if compiled is None:
+                # stage feeds explicitly so the feed phase measures the
+                # real host->device transfer instead of hiding it inside
+                # the jitted call's dispatch (the transfer happens either
+                # way; committed default-device arrays are what jit would
+                # produce). The compiled path keeps shard_inputs as its
+                # staging step — an extra unsharded device_put would
+                # fight the jit's in_shardings.
+                feed_vals = {
+                    k: v if isinstance(v, jax.Array) else jax.device_put(v)
+                    for k, v in feed_vals.items()
+                }
+            jax.block_until_ready(list(feed_vals.values()))
+            t_f1 = time.perf_counter()
 
         # Ops needing explicit collectives (ring attention, sharded tables)
         # read the SPMD context at trace time, which happens inside the
@@ -297,12 +326,28 @@ class Executor:
                 except Exception:
                     self._drop_donated(scope, lowered)
                     raise
+            if ph:
+                t_c1 = time.perf_counter()
+                # device phase: drain the async dispatch queue. A
+                # deferred device error surfaces here instead of inside
+                # _commit — same donated-buffer hygiene as a failed call.
+                try:
+                    jax.block_until_ready((fetches, new_state))
+                except Exception:
+                    self._drop_donated(scope, lowered)
+                    raise
+                t_b1 = time.perf_counter()
             bundle = None
             if nplan is not None:
                 bundle, fetches = fetches[-1], fetches[:-1]
             try:
-                return self._commit(scope, fetch_names, fetches, new_state,
-                                    return_numpy, rec)
+                if ph:
+                    t_x0 = time.perf_counter()
+                out = self._commit(scope, fetch_names, fetches, new_state,
+                                   return_numpy, rec)
+                if ph:  # only a COMMITTED step gets phase-attributed
+                    t_x1 = time.perf_counter()
+                return out
             finally:
                 # decoded even when check_nan_inf raises — the provenance
                 # record is most valuable exactly then
@@ -317,6 +362,10 @@ class Executor:
             # needs for postmortem, and must be the last line of the log
             if rec is not None:
                 rec["wall_ms"] = (time.perf_counter() - t_run0) * 1e3
+                if t_x1 > 0.0:  # phases only for steps that completed
+                    self._attribute_phases(
+                        rec, step_idx, t_run0, t_f0, t_f1, t_c1, t_b1,
+                        t_x0, t_x1)
                 _monitor.log_step(rec)
 
     def run_steps(
@@ -379,6 +428,13 @@ class Executor:
         # contents still change through a writeable base. Mutable numpy
         # feeds are re-staged every call (same contract as run()); pass
         # jax.Arrays or owning frozen copies to get one-time staging.
+        # Phase marks (see run()): the stacking below IS the window's
+        # feed phase — device_put of the whole window dominates host
+        # cost, and the breakdown must show it.
+        ph = tele and _monitor.phases_active()
+        t_f0 = t_f1 = t_c1 = t_b1 = t_x0 = t_x1 = 0.0
+        if ph:
+            t_f0 = time.perf_counter()
         arrs = [fb[k] for fb in feed_list for k in feed_names]
         cacheable = all(
             isinstance(a, jax.Array)
@@ -404,6 +460,9 @@ class Executor:
                 # stale key. An uncacheable call leaves any existing
                 # entry alone: it can only hit on its own pinned arrs.
                 self._latest_stacked = (arrs, stacked)
+        if ph:
+            jax.block_until_ready(list(stacked.values()))
+            t_f1 = time.perf_counter()
         sig = tuple(
             (k, tuple(v.shape), str(v.dtype)) for k, v in sorted(
                 stacked.items())
@@ -478,14 +537,27 @@ class Executor:
                 except Exception:
                     self._drop_donated(scope, lowered)
                     raise
+            if ph:
+                t_c1 = time.perf_counter()
+                try:
+                    jax.block_until_ready((fetches, new_state, first_bad))
+                except Exception:
+                    self._drop_donated(scope, lowered)
+                    raise
+                t_b1 = time.perf_counter()
             bundle = None
             if nplan is not None:
                 bundle, fetches = fetches[-1], fetches[:-1]
             try:
-                return self._commit(scope, fetch_names, fetches, new_state,
-                                    return_numpy, rec,
-                                    nan_first_bad=first_bad,
-                                    window=(start, int(steps)))
+                if ph:
+                    t_x0 = time.perf_counter()
+                out = self._commit(scope, fetch_names, fetches, new_state,
+                                   return_numpy, rec,
+                                   nan_first_bad=first_bad,
+                                   window=(start, int(steps)))
+                if ph:  # only a COMMITTED window gets phase-attributed
+                    t_x1 = time.perf_counter()
+                return out
             finally:
                 if bundle is not None and _numerics.should_sample_window(
                         start, int(steps)):
@@ -502,6 +574,10 @@ class Executor:
             # logged even when the window raises (see run())
             if rec is not None:
                 rec["wall_ms"] = (time.perf_counter() - t_run0) * 1e3
+                if t_x1 > 0.0:  # whole-window totals, one verdict entry
+                    self._attribute_phases(
+                        rec, start, t_run0, t_f0, t_f1, t_c1, t_b1,
+                        t_x0, t_x1, steps=int(steps))
                 _monitor.log_step(rec)
 
     # --- shared plumbing for run()/run_steps() ---
@@ -539,7 +615,39 @@ class Executor:
         with _monitor.span("executor.compile"):
             t0 = time.perf_counter()
             entry = build()
-            return entry, (time.perf_counter() - t0) * 1e3
+            t1 = time.perf_counter()
+            # compiles get their own timeline track: a recompile storm
+            # reads as a dense compile row, not as mystery-long steps
+            _monitor.trace_event("executor.compile", "compile", t0, t1)
+            return entry, (t1 - t0) * 1e3
+
+    def _attribute_phases(self, rec, step_idx, t_run0, t_f0, t_f1, t_c1,
+                          t_b1, t_x0, t_x1, steps=1):
+        """Fold a completed step's perf_counter marks into the phase
+        breakdown: ``rec['phases']`` (ms), ``rec['bound']`` (the rolling
+        window's boundedness verdict), the ``pt_step_phase_seconds``
+        histograms, and — on trace-sampled steps — one timeline event
+        per phase segment (dispatch is two segments: host work before
+        feed staging and the jitted call itself)."""
+        feed_s = t_f1 - t_f0
+        disp_s = (t_f0 - t_run0) + (t_c1 - t_f1)
+        dev_s = t_b1 - t_c1
+        fetch_s = t_x1 - t_x0
+        rec["phases"] = {"feed": feed_s * 1e3, "dispatch": disp_s * 1e3,
+                         "device": dev_s * 1e3, "fetch": fetch_s * 1e3}
+        verdict = _monitor.record_step_phases(feed_s, disp_s, dev_s,
+                                              fetch_s)
+        if verdict is not None:
+            rec["bound"] = verdict
+        if _monitor.trace_step_sampled(step_idx, steps):
+            step = {"step": step_idx}
+            _monitor.trace_event("dispatch", "phase", t_run0, t_f0,
+                                 args=step)
+            _monitor.trace_event("feed", "phase", t_f0, t_f1, args=step)
+            _monitor.trace_event("dispatch", "phase", t_f1, t_c1,
+                                 args=step)
+            _monitor.trace_event("device", "phase", t_c1, t_b1, args=step)
+            _monitor.trace_event("fetch", "phase", t_x0, t_x1, args=step)
 
     def _gather_state(self, scope, lowered):
         state = {}

@@ -63,6 +63,22 @@ _DEFS: Dict[str, tuple] = {
     # on a stall, also dump the flight recorder (step ring buffer +
     # metrics snapshot + stall record) as JSON into this directory
     "stall_dump_dir": (str, "", "flight-recorder dump dir on stall"),
+    # per-step phase attribution (feed/dispatch/device/fetch): on by
+    # default with telemetry, but separately disablable because honest
+    # device timing costs a jax.block_until_ready per step — a user who
+    # wants only cheap counters/step-logs can keep async dispatch
+    "step_phases": (bool, True,
+                    "measure per-step phases (adds a device sync)"),
+    # trace-event timeline (monitor.py): host spans, executor step
+    # phases, compiles and stall records buffered as Chrome-trace events
+    # and written as trace-<host>-<pid>.json into this directory at
+    # process exit (or monitor.export_trace()); empty = no file, but the
+    # /trace route still serves the ring while the live endpoint is up
+    "trace_dir": (str, "", "Chrome-trace timeline output directory"),
+    # sample step-phase trace events every N executor steps (spans,
+    # compiles and stalls are always traced while tracing is active —
+    # phase events are the per-step volume this bounds); 1 = every step
+    "trace_every_n_steps": (int, 1, "step-phase trace sampling period"),
     # device-side numerics plane (numerics.py): executors fetch + decode
     # the in-graph tensor-stats bundle of instrumented programs into
     # pt_tensor_* / pt_nonfinite_* instruments and NaN-provenance

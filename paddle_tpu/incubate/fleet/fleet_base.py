@@ -121,6 +121,10 @@ class Fleet:
                 )
             _M_RENDEZVOUS.inc()
             atexit.register(self.stop_worker)
+        # tag this process's trace exports with its rank so
+        # monitor.merge_traces lands each worker's events on its own
+        # track (single-worker jobs stay rank 0)
+        _monitor.set_trace_rank(self._role.worker_index())
         self._initialized = True
         return self
 

@@ -52,6 +52,28 @@ Grown in PR 2 with the compile & memory observability plane:
    carrying the active span stack + last step record, and (gated on
    ``stall_dump_dir``) dumps the flight recorder to disk.
 
+Grown in PR 4 with the time-attribution plane:
+
+7. **Step phases + boundedness verdict** — executors split every step
+   into ``feed`` (host->device staging), ``dispatch`` (Python + tracing
+   overhead), ``device`` (delta to ``jax.block_until_ready``) and
+   ``fetch`` (device->host + decode); ``record_step_phases`` feeds the
+   ``pt_step_phase_seconds`` histograms and a rolling window whose
+   verdict (``input_bound`` / ``dispatch_bound`` / ``device_bound``)
+   names the bottleneck. Input-pipeline consumer waits (reader queues,
+   data_feeder batch assembly) accumulate via ``note_input_wait`` and
+   weigh into the verdict, so a starved step is attributed to the input
+   pipeline, not the device.
+
+8. **Trace-event timeline** — every host span (via the
+   ``profiler.record_event`` hook), step phase, compile and stall
+   record becomes one Chrome-trace/Perfetto event in a bounded
+   in-memory ring; ``export_trace()`` writes
+   ``trace-<host>-<pid>.json`` under the ``trace_dir`` flag (also at
+   process exit), the ``/trace`` route serves it live, and
+   ``merge_traces()`` combines fleet-worker files onto per-rank tracks
+   with clock-offset alignment.
+
 Everything is off by default behind typed flags (flags.py); flipping
 ``telemetry`` at runtime takes effect immediately via a flag watcher,
 and every disabled instrument call costs one module-level boolean check.
@@ -391,6 +413,13 @@ def reset():
         _COMPILE_REPORTS.clear()
     _STALLS.clear()
     _stall_seq = 0
+    with _TRACE_LOCK:
+        _TRACE_RING.clear()
+    global _input_wait_s, _last_bound
+    with _BOUND_LOCK:
+        _input_wait_s = 0.0
+        _bound_window.clear()
+        _last_bound = None
     import sys
 
     # numerics rides the same test-isolation hook; lazy so importing
@@ -547,6 +576,14 @@ STEP_LOG_FIELDS: Dict[str, tuple] = {
                  "sampled numerics-bundle summary (numerics.py): "
                  "instrumented var count, non-finite var count, "
                  "first_bad {op, op_type, var} or null, aux gauges"),
+    "phases": ((dict,), False,
+               "per-phase time attribution in ms: feed (host->device "
+               "staging), dispatch (Python + tracing overhead), device "
+               "(delta to block_until_ready), fetch (device->host + "
+               "decode); windows carry whole-window totals"),
+    "bound": ((str,), False,
+              "boundedness verdict over the trailing step window: "
+              "'input_bound', 'dispatch_bound' or 'device_bound'"),
     "strategy": ((str, type(None)), True,
                  "SPMD strategy id (mesh axes) or null for plain runs"),
 }
@@ -996,6 +1033,8 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
     - ``/compile``  JSON latest compile report per program
     - ``/numerics`` JSON numerics plane: NaN/Inf provenance records +
       latest decoded tensor stats per program (numerics.py)
+    - ``/trace``    Chrome-trace JSON of the timeline ring (load it in
+      Perfetto / chrome://tracing directly)
 
     Binds localhost by default: metrics can carry program names — scrape
     through a sidecar or port-forward, don't expose it."""
@@ -1042,6 +1081,10 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
                     body = json.dumps(_numerics.summary(), sort_keys=True,
                                       default=str).encode()
                     ctype = "application/json"
+                elif path == "/trace":
+                    body = json.dumps(trace_snapshot(),
+                                      default=str).encode()
+                    ctype = "application/json"
                 else:
                     self.send_error(404)
                     return
@@ -1063,6 +1106,7 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
     _server_thread = threading.Thread(
         target=_server.serve_forever, name="pt-monitor-http", daemon=True)
     _server_thread.start()
+    _sync_trace_on()  # a live /trace route makes the timeline visible
     return _server.server_address[1]
 
 
@@ -1079,6 +1123,7 @@ def stop_server():
     if _server_thread is not None:
         _server_thread.join(timeout=5)
         _server_thread = None
+    _sync_trace_on()
 
 
 def _maybe_autostart_server(_value=None):
@@ -1196,6 +1241,9 @@ def _record_stall(site: str, deadline_ms: float, thread_name: str,
         }
         _STALLS.append(rec)
         _stall_counter().inc(labels={"site": site})
+        trace_event(f"stall:{site}", "stall", time.perf_counter(),
+                    args={"deadline_ms": deadline_ms, "thread": thread_name,
+                          "span_stack": list(spans)})
         warnings.warn(
             f"stall watchdog: {site!r} exceeded {deadline_ms:.0f} ms "
             f"(thread {thread_name}, spans {list(spans)}); the section "
@@ -1224,6 +1272,479 @@ def stalls() -> List[Dict[str, Any]]:
     return [dict(r) for r in _STALLS]
 
 
+# ---------------------------------------------------------------------------
+# time attribution: step phases + boundedness verdict
+# ---------------------------------------------------------------------------
+
+# Phase names, in execution order. The executor measures each with
+# perf_counter pairs; the semantics are documented in STEP_LOG_FIELDS
+# ('phases') and README "Step-time attribution & traces".
+STEP_PHASES = ("feed", "dispatch", "device", "fetch")
+
+BOUND_VERDICTS = ("input_bound", "dispatch_bound", "device_bound")
+
+# Rolling verdict window: per-step (input, dispatch, device) scores of
+# the last N steps. Small on purpose — the verdict should track the
+# CURRENT bottleneck, not average a warmup compile into steady state.
+BOUND_WINDOW = 16
+
+_M_STEP_PHASE = None
+_M_STEP_BOUND = None
+_M_READER_DEPTH = None
+_M_READER_WAIT = None
+_M_FEED_BUILD = None
+
+
+def _phase_instruments():
+    global _M_STEP_PHASE, _M_STEP_BOUND, _M_READER_DEPTH, _M_READER_WAIT
+    global _M_FEED_BUILD
+    if _M_STEP_PHASE is None:
+        _M_STEP_PHASE = histogram(
+            "pt_step_phase_seconds",
+            "per-step executor time attribution, by phase (feed = "
+            "host->device staging, dispatch = Python + tracing "
+            "overhead, device = delta to block_until_ready, fetch = "
+            "device->host + decode)")
+        _M_STEP_BOUND = counter(
+            "pt_step_bound_total",
+            "steps attributed to each boundedness verdict over the "
+            "trailing window (input_bound / dispatch_bound / "
+            "device_bound)")
+        _M_READER_DEPTH = gauge(
+            "pt_reader_queue_depth",
+            "input-pipeline queue depth after the latest put/get, by "
+            "site (buffered, xmap_in, xmap_out, multiprocess, "
+            "device_loader)")
+        _M_READER_WAIT = histogram(
+            "pt_reader_wait_seconds",
+            "time blocked on input-pipeline queues, by site and role "
+            "(producer = queue full, downstream slow; consumer = queue "
+            "empty, input-bound)")
+        _M_FEED_BUILD = histogram(
+            "pt_feed_build_seconds",
+            "DataFeeder.feed batch-assembly time (host input prep on "
+            "the critical path)")
+
+
+# cached hot gate for the executor's phase marks: telemetry on AND the
+# step_phases flag (default True). Separate from `telemetry` because the
+# device phase needs a per-step block_until_ready — honest attribution
+# costs the async-dispatch overlap, and metrics-only users can opt out.
+_phases_on = False
+
+
+def phases_active() -> bool:
+    """Whether executors should measure per-step phases (telemetry on
+    and the ``step_phases`` flag set)."""
+    return _phases_on
+
+
+def _sync_phases_on(_value=None):
+    global _phases_on, _input_wait_s
+    was = _phases_on
+    _phases_on = _enabled and bool(_flags.get_flag("step_phases"))
+    if _phases_on and not was:
+        # waits accumulated while nobody was draining (phases off, or a
+        # failed-step run) must not dump into the first attributed
+        # step's input score and pin the verdict to input_bound
+        with _BOUND_LOCK:
+            _input_wait_s = 0.0
+
+
+# input-wait accumulator: reader consumer waits + feed-build time since
+# the last executor step, drained into that step's verdict scores
+_BOUND_LOCK = threading.Lock()
+_input_wait_s = 0.0
+_bound_window: collections.deque = collections.deque(maxlen=BOUND_WINDOW)
+_last_bound: Optional[Dict[str, Any]] = None
+
+
+def note_input_wait(seconds: float):
+    """Accumulate input-pipeline time (a consumer wait on a reader
+    queue, or batch-assembly time) toward the NEXT step's boundedness
+    verdict. Gated on ``phases_active()`` — with nobody draining the
+    accumulator (phases off), accumulation would only grow a stale
+    backlog."""
+    global _input_wait_s
+    if not _phases_on:
+        return
+    with _BOUND_LOCK:
+        _input_wait_s += seconds
+
+
+def reader_wait(site: str, role: str, seconds: float):
+    """Record one blocked queue operation from the input pipeline
+    (``role``: 'producer' = put blocked on a full queue, 'consumer' =
+    get blocked on an empty one). Consumer waits additionally count
+    toward the boundedness verdict — a step that waited on its reader
+    is input-bound no matter how busy the device was afterwards."""
+    if not _enabled:
+        return
+    _M_READER_WAIT.observe(seconds, labels={"site": site, "role": role})
+    if role == "consumer":
+        note_input_wait(seconds)
+
+
+def reader_depth(site: str, depth: int):
+    """Gauge the queue depth observed after a put/get at ``site``."""
+    if not _enabled:
+        return
+    _M_READER_DEPTH.set(depth, labels={"site": site})
+
+
+def feed_build(seconds: float):
+    """Record one DataFeeder.feed batch assembly (host input prep);
+    counts toward the boundedness verdict's input score."""
+    if not _enabled:
+        return
+    _M_FEED_BUILD.observe(seconds)
+    note_input_wait(seconds)
+
+
+def timed_put(q, item, site: str):
+    """``q.put(item)`` with producer-wait + depth telemetry for queue
+    ``site`` (a plain put while telemetry is off) — the one shared
+    instrumentation point for every reader-pipeline queue."""
+    if not _enabled:
+        q.put(item)
+        return
+    t0 = time.perf_counter()
+    q.put(item)
+    reader_wait(site, "producer", time.perf_counter() - t0)
+    reader_depth(site, q.qsize())
+
+
+def timed_get(q, site: str):
+    """``q.get()`` with consumer-wait + depth telemetry for queue
+    ``site`` (consumer waits weigh into the boundedness verdict)."""
+    if not _enabled:
+        return q.get()
+    t0 = time.perf_counter()
+    item = q.get()
+    reader_wait(site, "consumer", time.perf_counter() - t0)
+    reader_depth(site, q.qsize())
+    return item
+
+
+def record_step_phases(feed_s: float, dispatch_s: float, device_s: float,
+                       fetch_s: float) -> Optional[str]:
+    """Record one step's phase breakdown: observes the
+    ``pt_step_phase_seconds`` histograms, drains the input-wait
+    accumulator into this step, pushes the scores into the rolling
+    verdict window and returns the window's verdict (also counted into
+    ``pt_step_bound_total{verdict=}``).
+
+    Verdict scoring: ``input`` = reader consumer waits + feed-build
+    time since the last step + the feed phase (host->device staging is
+    the input pipeline's device half); ``dispatch`` = dispatch + fetch
+    (host overhead around the device call); ``device`` = the device
+    phase. The largest share over the window names the bottleneck."""
+    global _last_bound, _input_wait_s
+    if not _enabled:
+        return None
+    _M_STEP_PHASE.observe(feed_s, labels={"phase": "feed"})
+    _M_STEP_PHASE.observe(dispatch_s, labels={"phase": "dispatch"})
+    _M_STEP_PHASE.observe(device_s, labels={"phase": "device"})
+    _M_STEP_PHASE.observe(fetch_s, labels={"phase": "fetch"})
+    with _BOUND_LOCK:
+        input_s = _input_wait_s + feed_s
+        _input_wait_s = 0.0
+        _bound_window.append((input_s, dispatch_s + fetch_s, device_s))
+        sums = [sum(col) for col in zip(*_bound_window)]
+        total = sum(sums) or 1.0
+        scores = dict(zip(("input", "dispatch", "device"), sums))
+        verdict = BOUND_VERDICTS[sums.index(max(sums))]
+        _last_bound = {
+            "verdict": verdict,
+            "shares": {k: v / total for k, v in scores.items()},
+            "steps": len(_bound_window),
+        }
+    _M_STEP_BOUND.inc(labels={"verdict": verdict})
+    return verdict
+
+
+def boundedness() -> Optional[Dict[str, Any]]:
+    """Latest boundedness verdict: ``{verdict, shares: {input,
+    dispatch, device}, steps}`` over the trailing window, or None before
+    the first telemetry-on step."""
+    with _BOUND_LOCK:
+        if _last_bound is None:
+            return None
+        return {"verdict": _last_bound["verdict"],
+                "shares": dict(_last_bound["shares"]),
+                "steps": _last_bound["steps"]}
+
+
+# ---------------------------------------------------------------------------
+# trace-event timeline (Chrome trace / Perfetto)
+# ---------------------------------------------------------------------------
+
+TRACE_SCHEMA_VERSION = 1
+
+# The memory contract: a week-long job buffers the same trailing window
+# as a smoke test. At ~120 B/event this is ~1 MB.
+TRACE_RING_CAPACITY = 8192
+
+# One clock for every event: perf_counter intervals anchored ONCE to the
+# wall clock at import. ts values are unix-epoch microseconds (what
+# Perfetto expects), but their DELTAS are monotonic perf_counter deltas
+# — a wall-clock step (NTP slew) can never reorder or stretch the
+# timeline within a process.
+_TRACE_ANCHOR_PERF = time.perf_counter()
+_TRACE_ANCHOR_UNIX = time.time()
+
+# Synthetic track (tid) per event category, so spans, step phases,
+# compiles and stalls render as distinct rows instead of interleaving on
+# the emitting thread's row. Names are exported as thread_name metadata.
+TRACE_TRACKS = {
+    "span": (1, "host spans"),
+    "phase": (2, "step phases"),
+    "compile": (3, "compiles"),
+    "stall": (4, "stalls"),
+    "profiler": (5, "profiler"),
+}
+
+_TRACE_LOCK = threading.Lock()
+_TRACE_RING: collections.deque = collections.deque(
+    maxlen=TRACE_RING_CAPACITY)
+
+# cached hot gate: telemetry on AND someone can see the trace (trace_dir
+# configured or the live endpoint up) — same visibility rule as compile
+# reports, so tracing is never on by accident
+_trace_on = False
+_trace_every = 1
+_trace_rank = 0
+_HOSTNAME = (os.environ.get("HOSTNAME") or "host").split(".")[0]
+
+_M_TRACE_EVENTS = None
+_M_TRACE_DROPPED = None
+
+
+def _trace_instruments():
+    global _M_TRACE_EVENTS, _M_TRACE_DROPPED
+    if _M_TRACE_EVENTS is None:
+        _M_TRACE_EVENTS = counter(
+            "pt_trace_events_total",
+            "trace events appended to the timeline ring")
+        _M_TRACE_DROPPED = counter(
+            "pt_trace_events_dropped_total",
+            "oldest trace events evicted by the bounded ring")
+
+
+def trace_active() -> bool:
+    """True when trace events are being collected: telemetry on AND
+    (``trace_dir`` configured or the live endpoint running)."""
+    return _trace_on
+
+
+def trace_step_sampled(step: int, steps: int = 1) -> bool:
+    """Gate for per-step phase trace events: tracing active and the
+    ``trace_every_n_steps`` period has a sample point inside
+    ``[step, step + steps)`` — so a run_steps window is sampled whenever
+    ANY of its steps would be, instead of aliasing the window stride
+    against the period."""
+    if not _trace_on:
+        return False
+    if _trace_every <= 1:
+        return True
+    return (-step) % _trace_every < steps
+
+
+def _ts_us(t_perf: float) -> float:
+    return (_TRACE_ANCHOR_UNIX + (t_perf - _TRACE_ANCHOR_PERF)) * 1e6
+
+
+def trace_event(name: str, cat: str, t0: float,
+                t1: Optional[float] = None,
+                args: Optional[Dict[str, Any]] = None):
+    """Append one event to the timeline ring (no-op unless
+    ``trace_active()``). ``t0``/``t1`` are ``time.perf_counter``
+    readings: a pair makes a complete ('X') event with a duration, a
+    lone ``t0`` an instant ('i') event. Never raises — telemetry must
+    not fail a step."""
+    if not _trace_on:
+        return
+    ev: Dict[str, Any] = {
+        "name": name,
+        "cat": cat,
+        "ph": "X" if t1 is not None else "i",
+        "ts": _ts_us(t0),
+        "pid": os.getpid(),
+        "tid": TRACE_TRACKS.get(cat, (0, ""))[0],
+    }
+    if t1 is not None:
+        ev["dur"] = max(t1 - t0, 0.0) * 1e6
+    else:
+        ev["s"] = "p"  # instant events span the process track
+    if args:
+        ev["args"] = args
+    with _TRACE_LOCK:
+        dropped = len(_TRACE_RING) == TRACE_RING_CAPACITY
+        _TRACE_RING.append(ev)
+    _M_TRACE_EVENTS.inc()
+    if dropped:
+        _M_TRACE_DROPPED.inc()
+
+
+def _emit_span_trace(name: str, t0: float, t1: float):
+    """profiler.record_event trace hook target: every host span —
+    monitor.span bodies AND legacy direct record_event callers — lands
+    in the ring through this one function, on the profiler's clock."""
+    trace_event(name, "span", t0, t1)
+
+
+def _span_trace_hook():
+    """Installed as profiler._trace_hook: returns the emit function
+    while tracing is active, else None (one boolean check, no
+    allocation — record_event sits on disabled hot paths)."""
+    return _emit_span_trace if _trace_on else None
+
+
+def set_trace_rank(rank: int):
+    """Tag this process's exported trace with its fleet rank (called by
+    fleet.init) so merge_traces lands its events on the right track."""
+    global _trace_rank
+    _trace_rank = int(rank)
+
+
+def trace_events() -> List[Dict[str, Any]]:
+    """Buffered trace events, ts-ordered (the ring is append-ordered
+    per thread; sorting makes ts monotone per track)."""
+    with _TRACE_LOCK:
+        evs = [dict(e) for e in _TRACE_RING]
+    evs.sort(key=lambda e: e["ts"])
+    return evs
+
+
+def trace_snapshot() -> Dict[str, Any]:
+    """The exportable Chrome-trace JSON object: thread/process metadata
+    events + the ts-sorted ring, plus a ``metadata`` block carrying the
+    clock anchor and rank that merge_traces aligns on."""
+    pid = os.getpid()
+    meta_events: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "ts": 0, "pid": pid, "tid": 0,
+        "args": {"name": f"rank{_trace_rank} ({_HOSTNAME}:{pid})"},
+    }]
+    for _cat, (tid, label) in sorted(TRACE_TRACKS.items()):
+        meta_events.append({
+            "name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
+            "tid": tid, "args": {"name": label},
+        })
+    return {
+        "traceEvents": meta_events + trace_events(),
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "v": TRACE_SCHEMA_VERSION,
+            "rank": _trace_rank,
+            "host": _HOSTNAME,
+            "os_pid": pid,
+            "anchor_unix": _TRACE_ANCHOR_UNIX,
+        },
+    }
+
+
+def export_trace(path: Optional[str] = None) -> Optional[str]:
+    """Write the trace snapshot as JSON: to ``path`` when given, else
+    as ``trace-<host>-<pid>.json`` under the ``trace_dir`` flag (None
+    and no write when neither is set). Returns the written path."""
+    if path is None:
+        out_dir = _flags.get_flag("trace_dir")
+        if not out_dir:
+            return None
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(
+            out_dir, f"trace-{_HOSTNAME}-{os.getpid()}.json")
+    with open(path, "w") as f:
+        json.dump(trace_snapshot(), f, default=str)
+    return path
+
+
+def merge_traces(traces: Iterable, out_path: Optional[str] = None,
+                 offsets_us: Optional[Dict[int, float]] = None) -> Dict:
+    """Combine per-process trace files (paths or already-loaded dicts)
+    into ONE timeline: each worker's events move onto ``pid = rank``
+    tracks (rank from the trace's metadata, falling back to input
+    order) and timestamps align across processes.
+
+    Clock-offset alignment: every export's ts values are anchored to
+    that process's wall clock at import (``metadata.anchor_unix``), so
+    NTP-synced hosts line up out of the box; a residual measured skew
+    can be corrected per rank via ``offsets_us``. The merged timeline
+    is rebased to start at 0 — a multi-worker stall reads as one gap
+    across all rank tracks."""
+    loaded = []
+    seen_ranks = set()
+    for i, t in enumerate(traces):
+        if isinstance(t, str):
+            with open(t) as f:
+                t = json.load(f)
+        meta = t.get("metadata") or {}
+        rank = meta.get("rank")
+        if rank is None or rank in seen_ranks:
+            # collision/absence fallback: the smallest unused rank, so
+            # two traces can never share a pid track (input order is
+            # preserved for the well-tagged common case)
+            rank = 0
+            while rank in seen_ranks:
+                rank += 1
+        seen_ranks.add(rank)
+        off = float((offsets_us or {}).get(rank, 0.0))
+        loaded.append((rank, off, t))
+    base = None
+    for rank, off, t in loaded:
+        for ev in t.get("traceEvents", ()):
+            if ev.get("ph") != "M":
+                ts = float(ev.get("ts", 0.0)) + off
+                base = ts if base is None else min(base, ts)
+    base = base or 0.0
+    meta_events: List[Dict[str, Any]] = []
+    data_events: List[Dict[str, Any]] = []
+    for rank, off, t in loaded:
+        for ev in t.get("traceEvents", ()):
+            ev = dict(ev)
+            ev["pid"] = rank
+            if ev.get("ph") == "M":
+                meta_events.append(ev)
+            else:
+                ev["ts"] = float(ev.get("ts", 0.0)) + off - base
+                data_events.append(ev)
+    data_events.sort(key=lambda e: e["ts"])
+    merged = {
+        "traceEvents": meta_events + data_events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "v": TRACE_SCHEMA_VERSION,
+            "merged_ranks": sorted(seen_ranks),
+        },
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(merged, f, default=str)
+    return merged
+
+
+def _sync_trace_on(_value=None):
+    global _trace_on
+    _trace_on = _enabled and (bool(_flags.get_flag("trace_dir"))
+                              or _server is not None)
+
+
+def _sync_trace_every(value):
+    global _trace_every
+    _trace_every = int(value)
+
+
+def _dump_trace_at_exit():
+    if _enabled and _flags.get_flag("trace_dir"):
+        try:
+            export_trace()
+        except OSError:
+            pass
+
+
+atexit.register(_dump_trace_at_exit)
+
+
 # Eagerly register monitor-owned instruments: a /metrics scrape (or the
 # doc-coverage test) sees the full builtin set even before the first
 # span/stall/compile happens.
@@ -1232,11 +1753,24 @@ _span_seconds = histogram(
 _overflow_total()
 _stall_counter()
 _compile_instruments()
+_phase_instruments()
+_trace_instruments()
+
+# Route every profiler.record_event host span into the trace ring: the
+# legacy profiler API and the new timeline share one clock and one
+# event stream (the hook returns None while tracing is off, so the
+# record_event disabled path stays a bare yield).
+_profiler._trace_hook = _span_trace_hook
 
 # register watchers last so the module is fully initialized when the
 # immediate callbacks fire (env-set flags take effect at import)
 _flags.watch_flag("telemetry", _sync_from_flags)
 _flags.watch_flag("telemetry", _maybe_autostart_server)
+_flags.watch_flag("telemetry", _sync_trace_on)
+_flags.watch_flag("telemetry", _sync_phases_on)
+_flags.watch_flag("step_phases", _sync_phases_on)
 _flags.watch_flag("metrics_port", _maybe_autostart_server)
+_flags.watch_flag("trace_dir", _sync_trace_on)
+_flags.watch_flag("trace_every_n_steps", _sync_trace_every)
 _flags.watch_flag("device_memory_budget_bytes", _sync_mem_budget)
 _flags.watch_flag("stall_timeout_ms", _sync_stall_ms)

@@ -10,12 +10,30 @@ from __future__ import annotations
 
 import contextlib
 import os
+import time
 import warnings
 from typing import Optional
 
 # Fast-path flag so per-step record_event calls cost one attribute check
 # when profiling is off.
 _host_enabled = False
+
+# Trace-timeline hook, installed by monitor.py at import: a zero-arg
+# callable returning either an ``emit(name, t0_perf, t1_perf)`` function
+# (trace collection active) or None. Keeping the gate on monitor's side
+# means record_event needs no monitor import and the old profiler API
+# and the new timeline share ONE clock (perf_counter) and one stream.
+_trace_hook = None
+
+
+def _trace_mark(name: str):
+    """Instant event on the timeline (no-op unless monitor's trace
+    collection is active) marking a legacy profiler lifecycle call."""
+    import sys
+
+    monitor = sys.modules.get("paddle_tpu.monitor")
+    if monitor is not None:
+        monitor.trace_event(name, "profiler", time.perf_counter())
 
 
 @contextlib.contextmanager
@@ -35,6 +53,7 @@ def profiler(state: str = "All", sorted_key: Optional[str] = None,
     if use_native:
         native.profiler_enable()
         _host_enabled = True
+    _trace_mark("profiler.start")
     jax_trace_dir = profile_path + "_xplane"
     jax_started = False
     if with_xplane:
@@ -63,6 +82,7 @@ def profiler(state: str = "All", sorted_key: Optional[str] = None,
                     f"jax.profiler.stop_trace() failed; the xplane trace "
                     f"under {jax_trace_dir!r} may be missing or "
                     f"truncated: {e!r}", RuntimeWarning, stacklevel=3)
+        _trace_mark("profiler.stop")
         if use_native:
             native.profiler_disable()
             _host_enabled = False
@@ -71,17 +91,29 @@ def profiler(state: str = "All", sorted_key: Optional[str] = None,
 
 @contextlib.contextmanager
 def record_event(name: str):
-    """RAII host span (reference: platform/profiler.h:81 RecordEvent)."""
-    if not _host_enabled:
+    """RAII host span (reference: platform/profiler.h:81 RecordEvent).
+
+    With monitor's trace collection active every span — including
+    legacy direct callers of this API — additionally lands in the
+    trace-event ring on the same perf_counter clock as the new
+    timeline. Both collectors off: a bare yield."""
+    emit = _trace_hook() if _trace_hook is not None else None
+    host = _host_enabled
+    if not host and emit is None:
         yield
         return
-    from paddle_tpu import native
+    if host:
+        from paddle_tpu import native
 
-    native.profiler_begin(name)
+        native.profiler_begin(name)
+    t0 = time.perf_counter()
     try:
         yield
     finally:
-        native.profiler_end()
+        if emit is not None:
+            emit(name, t0, time.perf_counter())
+        if host:
+            native.profiler_end()
 
 
 def start_profiler(state: str = "All"):
@@ -91,6 +123,7 @@ def start_profiler(state: str = "All"):
     if native.available():
         native.profiler_enable()
         _host_enabled = True
+    _trace_mark("profiler.start")
 
 
 def stop_profiler(sorted_key: Optional[str] = None,
@@ -98,6 +131,7 @@ def stop_profiler(sorted_key: Optional[str] = None,
     global _host_enabled
     from paddle_tpu import native
 
+    _trace_mark("profiler.stop")
     if native.available():
         native.profiler_disable()
         _host_enabled = False

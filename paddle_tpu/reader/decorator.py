@@ -11,7 +11,10 @@ import itertools
 import queue
 import random as _random
 import threading
+import time
 from typing import Callable, Iterable, List
+
+from paddle_tpu import monitor as _monitor
 
 
 def map_readers(func, *readers):
@@ -64,26 +67,38 @@ def compose(*readers, check_alignment: bool = True):
 
 def buffered(reader, size: int):
     """Background-thread prefetch (reference: decorator.py buffered) — the
-    host half of double-buffering; device prefetch is reader/pipeline.py."""
+    host half of double-buffering; device prefetch is reader/pipeline.py.
+
+    A producer exception is captured and re-raised in the consumer (the
+    ``finally: put(_End)`` still unblocks it first, so propagation is
+    bounded by one queue drain, never a hang). With telemetry on, queue
+    depth and producer/consumer waits feed the input-pipeline
+    instruments (``pt_reader_queue_depth{site="buffered"}``,
+    ``pt_reader_wait_seconds``) and the boundedness verdict."""
 
     class _End:
         pass
 
     def data_reader():
         q: queue.Queue = queue.Queue(maxsize=size)
+        failure: List[BaseException] = []
 
         def worker():
             try:
                 for d in reader():
-                    q.put(d)
+                    _monitor.timed_put(q, d, "buffered")
+            except BaseException as e:  # re-raised by the consumer —
+                failure.append(e)       # never a silently short epoch
             finally:
                 q.put(_End)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
         while True:
-            e = q.get()
+            e = _monitor.timed_get(q, "buffered")
             if e is _End:
+                if failure:
+                    raise failure[0]
                 break
             yield e
 
@@ -116,20 +131,36 @@ def cache(reader):
 def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
                  order: bool = False):
     """Multi-thread sample transform (reference: decorator.py xmap_readers).
-    ``order=True`` preserves input order via sequence numbers."""
+    ``order=True`` preserves input order via sequence numbers.
+
+    A raising ``mapper`` (or source reader) posts an error sentinel
+    before its end marker, and the consumer re-raises on the NEXT get —
+    bounded-time propagation in both modes. Without it, a dead worker
+    never posts ``_End`` so the consumer blocks forever, and ordered
+    mode additionally hangs on the sequence gap the lost sample leaves.
+    Telemetry feeds ``pt_reader_queue_depth{site="xmap_in"/"xmap_out"}``
+    and the producer/consumer wait histograms."""
 
     class _End:
         pass
+
+    class _Err:
+        def __init__(self, exc: BaseException):
+            self.exc = exc
 
     def data_reader():
         in_q: queue.Queue = queue.Queue(buffer_size)
         out_q: queue.Queue = queue.Queue(buffer_size)
 
         def feeder():
-            for i, s in enumerate(reader()):
-                in_q.put((i, s))
-            for _ in range(process_num):
-                in_q.put(_End)
+            try:
+                for i, s in enumerate(reader()):
+                    _monitor.timed_put(in_q, (i, s), "xmap_in")
+            except BaseException as e:  # source reader failed: surface
+                out_q.put(_Err(e))      # it in the consumer
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_End)
 
         def worker():
             while True:
@@ -138,16 +169,31 @@ def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
                     out_q.put(_End)
                     break
                 i, s = item
-                out_q.put((i, mapper(s)))
+                try:
+                    mapped = mapper(s)
+                except BaseException as e:
+                    # error BEFORE the end marker: the consumer raises
+                    # on its next get instead of waiting out a sequence
+                    # gap / missing _End forever
+                    out_q.put(_Err(e))
+                    out_q.put(_End)
+                    break
+                _monitor.timed_put(out_q, (i, mapped), "xmap_out")
 
         threading.Thread(target=feeder, daemon=True).start()
         for _ in range(process_num):
             threading.Thread(target=worker, daemon=True).start()
 
+        def _next():
+            item = _monitor.timed_get(out_q, "xmap_out")
+            if isinstance(item, _Err):
+                raise item.exc
+            return item
+
         ended = 0
         if not order:
             while ended < process_num:
-                item = out_q.get()
+                item = _next()
                 if item is _End:
                     ended += 1
                     continue
@@ -160,7 +206,13 @@ def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
                 yield pending.pop(next_idx)
                 next_idx += 1
                 continue
-            item = out_q.get()
+            if ended == process_num:
+                # every worker ended yet the next sequence number never
+                # arrived: a sample was lost without an error sentinel
+                raise RuntimeError(
+                    f"xmap_readers(order=True): sequence gap at sample "
+                    f"{next_idx} ({len(pending)} later samples buffered)")
+            item = _next()
             if item is _End:
                 ended += 1
                 continue
@@ -214,15 +266,28 @@ def multiprocess_reader(readers, use_pipe: bool = True,
         ended = 0
         try:
             while ended < len(readers):
-                try:
-                    tag, payload = q.get(timeout=5.0)
-                except _queue.Empty:
-                    if not any(p.is_alive() for p in procs):
-                        raise RuntimeError(
-                            "multiprocess_reader: worker process died "
-                            "without an end/error message (killed?)"
-                        )
-                    continue
+                # gate snapshotted across the wait: a runtime telemetry
+                # flip mid-get must not record perf_counter() - 0.0
+                obs = _monitor.enabled()
+                t_wait0 = time.perf_counter() if obs else 0.0
+                while True:
+                    try:
+                        tag, payload = q.get(timeout=5.0)
+                        break
+                    except _queue.Empty:
+                        if not any(p.is_alive() for p in procs):
+                            raise RuntimeError(
+                                "multiprocess_reader: worker process died "
+                                "without an end/error message (killed?)"
+                            )
+                if obs:
+                    # the total blocked time, Empty-timeout polls included
+                    _monitor.reader_wait("multiprocess", "consumer",
+                                         time.perf_counter() - t_wait0)
+                    try:
+                        _monitor.reader_depth("multiprocess", q.qsize())
+                    except NotImplementedError:  # qsize unsupported on
+                        pass                     # some platforms (macOS)
                 if tag == "end":
                     ended += 1
                 elif tag == "error":

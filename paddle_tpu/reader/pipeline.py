@@ -18,6 +18,8 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence
 import jax
 import numpy as np
 
+from paddle_tpu import monitor as _monitor
+
 
 class DeviceLoader:
     """Iterate numpy batches with K-deep device-side prefetch."""
@@ -47,7 +49,7 @@ class DeviceLoader:
                             k: jax.device_put(np.asarray(v), self._sharding)
                             for k, v in zip(self._names, sample)
                         }
-                    q.put(feed)
+                    _monitor.timed_put(q, feed, "device_loader")
             except BaseException as e:  # surface in the consumer, not the
                 failure.append(e)       # daemon thread's stderr
             finally:
@@ -55,7 +57,10 @@ class DeviceLoader:
 
         threading.Thread(target=worker, daemon=True).start()
         while True:
-            item = q.get()
+            # the consumer wait is THE input-bound signal: an empty
+            # prefetch queue means the step loop outran the host
+            # pipeline, and this wait weighs into the boundedness verdict
+            item = _monitor.timed_get(q, "device_loader")
             if item is END:
                 if failure:
                     raise RuntimeError(

@@ -70,6 +70,23 @@ def test_healthz_and_404():
     assert ei.value.code == 404
 
 
+def test_trace_endpoint_serves_live_timeline():
+    """A running server alone makes tracing visible (no trace_dir
+    needed): /trace returns loadable Chrome-trace JSON of the ring."""
+    monitor.enable()
+    port = monitor.serve(0)
+    assert monitor.trace_active()  # server IS the visibility sink
+    with monitor.span("served.from.ring"):
+        pass
+    status, ctype, body = _get(port, "/trace")
+    assert status == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    events = doc["traceEvents"]
+    assert any(e.get("name") == "served.from.ring" for e in events)
+    for e in events:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+
+
 def test_steps_endpoint_serves_ring_buffer():
     """Executor steps land in the bounded ring even with NO step_log_path
     — the /steps route is the zero-config live view."""

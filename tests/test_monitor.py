@@ -296,6 +296,16 @@ def test_validate_step_record_rejects_bad_records():
         monitor.validate_step_record(dict(good, nan_step="seven"))
     with pytest.raises(ValueError, match="type"):
         monitor.validate_step_record(dict(good, numerics="not-a-dict"))
+    # PR-4 optional fields: the phase breakdown and boundedness verdict
+    # validate when present, stay optional when not
+    monitor.validate_step_record(dict(
+        good, phases={"feed": 0.1, "dispatch": 0.2, "device": 0.3,
+                      "fetch": 0.05},
+        bound="device_bound"))
+    with pytest.raises(ValueError, match="type"):
+        monitor.validate_step_record(dict(good, phases=[0.1, 0.2]))
+    with pytest.raises(ValueError, match="type"):
+        monitor.validate_step_record(dict(good, bound=3))
 
 
 def test_log_step_unwritable_path_warns_once_never_raises(tmp_path):
@@ -361,6 +371,14 @@ def test_describe_flags_covers_every_flag_with_docs():
     assert by_name["numerics_every_n_steps"]["default"] == 1
     assert by_name["numerics_vars"]["type"] == "str"
     assert by_name["numerics_vars"]["default"] == ""
+    # the time-attribution plane's flags: phases on with telemetry,
+    # tracing off / every-step by default
+    assert by_name["step_phases"]["type"] == "bool"
+    assert by_name["step_phases"]["default"] is True
+    assert by_name["trace_dir"]["type"] == "str"
+    assert by_name["trace_dir"]["default"] == ""
+    assert by_name["trace_every_n_steps"]["type"] == "int"
+    assert by_name["trace_every_n_steps"]["default"] == 1
 
 
 def test_watch_flag_fires_immediately_and_on_change():
