@@ -31,12 +31,14 @@ from paddle_tpu.compiler import (  # noqa: F401
 from paddle_tpu import (  # noqa: F401
     dataset_api,
     debugger,
+    faults,
     flags,
     inference,
     install_check,
     monitor,
     passes,
     profiler,
+    retry,
     transpiler,
 )
 from paddle_tpu.dataset_api import DatasetFactory  # noqa: F401

@@ -12,10 +12,12 @@ from __future__ import annotations
 
 import os
 import shutil
+import warnings
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu import faults as _faults
 from paddle_tpu import io as _io
 from paddle_tpu import monitor as _monitor
 from paddle_tpu import numerics as _numerics
@@ -35,6 +37,14 @@ _M_CKPTS = _monitor.counter(
     "pt_trainer_checkpoints_total", "checkpoints saved")
 _M_LOSS = _monitor.gauge(
     "pt_trainer_last_loss", "loss fetched at the most recent step")
+_M_RESUMES = _monitor.counter(
+    "pt_trainer_auto_resumes_total",
+    "training failures auto-recovered by restoring the last valid "
+    "checkpoint (CheckpointConfig.max_resume_retries)")
+
+# chaos hook: armed plans can fail the Nth batch fetch, driving the
+# auto-resume loop deterministically (tests/test_faults.py)
+_F_READER_NEXT = _faults.site("reader.next")
 
 
 _RNG_STEP_KEY = "__trainer_rng_step__"
@@ -66,17 +76,24 @@ class EndStepEvent:
 class CheckpointConfig:
     """reference: contrib/trainer.py:100. Checkpoints are epoch-granular
     (resume replays from an epoch boundary; there is no mid-epoch data
-    cursor, so a step_interval would silently re-read data on resume)."""
+    cursor, so a step_interval would silently re-read data on resume).
+
+    ``max_resume_retries``: on a training failure (a raising step,
+    reader, or event handler), ``Trainer.train`` restores the newest
+    VALID checkpoint and continues from its epoch, at most this many
+    times per ``train()`` call. 0 (default) = fail fast."""
 
     def __init__(
         self,
         checkpoint_dir: str,
         epoch_interval: int = 1,
         max_num_checkpoints: int = 3,
+        max_resume_retries: int = 0,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.epoch_interval = max(1, int(epoch_interval))
         self.max_num_checkpoints = max(1, int(max_num_checkpoints))
+        self.max_resume_retries = max(0, int(max_resume_retries))
 
 
 class Trainer:
@@ -132,15 +149,20 @@ class Trainer:
     # --- checkpoint/resume (reference: contrib/trainer.py:285,580) ---
 
     def _maybe_resume(self):
+        """Restore the newest VALID checkpoint into the scope; returns
+        its serial, or None when there is nothing to resume. Single
+        read: load_latest verifies commit/coverage/checksums in the
+        same pass that yields the values."""
         cfg = self._ckpt_cfg
         if cfg is None:
-            return
-        step = _ckpt.latest_step(cfg.checkpoint_dir)
-        if step is None:
-            return
-        names = set(
-            _ckpt.restore_scope(cfg.checkpoint_dir, self.scope, step=step)
-        )
+            return None
+        loaded = _ckpt.load_latest(cfg.checkpoint_dir)
+        if loaded is None:
+            return None
+        step, values = loaded
+        for n, v in values.items():
+            self.scope.set(n, v)
+        names = set(values)
         # Every parameter of THIS program must be covered, or training
         # would silently continue from re-initialized values (auto-generated
         # var names drift when a program is rebuilt differently — name your
@@ -163,6 +185,7 @@ class Trainer:
             self.exe._step = int(np.asarray(rng_step))
             self.scope.drop(_RNG_STEP_KEY)
         self._start_epoch = step  # serial number = next epoch to run
+        return step
 
     def _save_checkpoint(self, serial: int):
         cfg = self._ckpt_cfg
@@ -171,16 +194,44 @@ class Trainer:
             _ckpt.save_scope(cfg.checkpoint_dir, self.scope, step=serial)
         finally:
             self.scope.drop(_RNG_STEP_KEY)
-        # prune old serial dirs beyond max_num_checkpoints (foreign
-        # entries like checkpoint_best are not ours to touch)
-        kept = sorted(
-            _ckpt.available_steps(cfg.checkpoint_dir), reverse=True
-        )[cfg.max_num_checkpoints:]
-        for s in kept:
-            shutil.rmtree(
-                os.path.join(cfg.checkpoint_dir, f"checkpoint_{s}"),
-                ignore_errors=True,
-            )
+        # Prune old serial dirs beyond max_num_checkpoints — only AFTER
+        # the new checkpoint committed (a failed save raises above and
+        # skips pruning), and never the last resumable state: the keep
+        # window holds the newest VALID serials, and invalid serials are
+        # reclaimed only when a NEWER valid one exists (so a transient
+        # validation failure can never delete the sole copy). Foreign
+        # entries like checkpoint_best are not ours to touch.
+        # The window membership below uses the cheap structural check;
+        # resume demands checksums too, so first prove the JUST-written
+        # serial to the full standard (page-cache read) — if even it
+        # fails, something is deeply wrong with the storage: keep
+        # everything rather than prune by a weaker validity definition.
+        if not _ckpt.validate_checkpoint(cfg.checkpoint_dir, serial):
+            warnings.warn(
+                f"checkpoint_{serial} failed checksum validation right "
+                f"after commit; skipping pruning", RuntimeWarning)
+            return
+        serials = sorted(
+            _ckpt.available_steps(cfg.checkpoint_dir), reverse=True)
+        valid = [s for s in serials
+                 if _ckpt.validate_checkpoint(cfg.checkpoint_dir, s,
+                                              verify_checksums=False)]
+        keep = set(valid[:cfg.max_num_checkpoints])
+        # the serial just written and checksum-PROVEN above is kept
+        # unconditionally: a structurally-complete-but-bit-rotted newer
+        # serial (possible after auto-resume lowered the numbering)
+        # must not crowd the one certainly-good checkpoint out
+        keep.add(serial)
+        newest_valid = valid[0] if valid else None
+        for s in serials:
+            if s in keep:
+                continue
+            if s in valid or (newest_valid is not None
+                              and s < newest_valid):
+                shutil.rmtree(
+                    os.path.join(cfg.checkpoint_dir, f"checkpoint_{s}"),
+                    ignore_errors=True,
+                )
 
     # --- the loop (reference: contrib/trainer.py:379) ---
 
@@ -200,6 +251,39 @@ class Trainer:
                 "Trainer.train needs `reader` (a callable returning an "
                 "iterable of batches) and `feed_order` (feed var names)"
             )
+        cfg = self._ckpt_cfg
+        retries = cfg.max_resume_retries if cfg is not None else 0
+        while True:
+            try:
+                return self._train_impl(
+                    num_epochs, event_handler, reader, feed_order,
+                    log_time_attribution)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — auto-resume budget
+                if retries <= 0:
+                    raise
+                retries -= 1
+                self._start_epoch = 0
+                self._stopped = False
+                with scope_guard(self.scope):
+                    step = self._maybe_resume()
+                if step is None:
+                    raise  # nothing valid to resume from
+                warnings.warn(
+                    f"training failed ({type(e).__name__}: {e}); "
+                    f"auto-resuming from checkpoint_{step} "
+                    f"({retries} retries left)", RuntimeWarning)
+                _M_RESUMES.inc()
+
+    def _train_impl(
+        self,
+        num_epochs: int,
+        event_handler: Optional[Callable],
+        reader: Callable,
+        feed_order: Sequence[str],
+        log_time_attribution: bool,
+    ):
         handler = event_handler or (lambda e: None)
         feeder = DataFeeder(
             [self.main_program.global_block().var(n) for n in feed_order]
@@ -214,6 +298,7 @@ class Trainer:
                     for step, batch in enumerate(reader()):
                         if self._stopped:
                             break
+                        _F_READER_NEXT.hit()
                         handler(BeginStepEvent(epoch, step))
                         # the step IS the collective in fleet jobs (GSPMD
                         # all-reduces ride inside the compiled program):

@@ -92,6 +92,25 @@ _DEFS: Dict[str, tuple] = {
     # instrument_numerics pass instruments (e.g. '*@GRAD,fc_*'); empty =
     # every float activation/gradient/parameter
     "numerics_vars": (str, "", "var-name filter for instrument_numerics"),
+    # deterministic fault-injection plan (faults.py):
+    # 'site:action@trigger[,trigger];site2:...' — e.g.
+    # 'ckpt.write_shards:raise@2;fleet.kv_get:delay(0.05)@1,3'. Actions:
+    # raise[(msg)] / delay(seconds) / truncate(bytes); triggers: Nth hit
+    # (1-based) or pFLOAT (per-hit probability from the fault_seed
+    # stream). Empty = injection disarmed (the one-boolean hot path).
+    "fault_plan": (str, "", "deterministic fault-injection plan"),
+    # seed for pFLOAT plan triggers: the per-site random stream is
+    # derived from (seed, site name), so a seeded chaos run reproduces
+    # its fault sequence exactly
+    "fault_seed": (int, 0, "seed for probabilistic fault-plan triggers"),
+    # unified retry policy (retry.py) used by fleet connect/kv/heartbeat:
+    # first backoff sleep; subsequent sleeps take decorrelated jitter in
+    # [base, 3*prev] capped at retry_max_delay_ms
+    "retry_base_delay_ms": (int, 100, "retry backoff base delay"),
+    "retry_max_delay_ms": (int, 5_000, "retry backoff delay cap"),
+    # attempts cap per retried call; 0 = bounded only by the call's
+    # deadline budget (rpc_deadline_ms or the caller's timeout)
+    "retry_max_attempts": (int, 0, "retry attempt cap (0 = deadline-only)"),
 }
 
 _values: Dict[str, Any] = {}

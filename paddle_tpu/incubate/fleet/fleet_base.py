@@ -29,7 +29,9 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu import faults as _faults
 from paddle_tpu import monitor as _monitor
+from paddle_tpu import retry as _retry
 from paddle_tpu.incubate.fleet.role_maker import (
     EnvRoleMaker,
     RoleMakerBase,
@@ -47,6 +49,18 @@ _M_RENDEZVOUS = _monitor.counter(
 _M_DEAD_EVENTS = _monitor.counter(
     "pt_fleet_dead_worker_events_total",
     "barrier_or_dead returns that reported dead peers")
+
+# chaos hooks: armed plans fail/delay the Nth coordination RPC, so the
+# retry policy's behavior is reproducibly testable (faults.py docstring)
+_F_CONNECT = _faults.site("fleet.connect")
+_F_KV_GET = _faults.site("fleet.kv_get")
+_F_KV_PUT = _faults.site("fleet.kv_put")
+_F_HEARTBEAT = _faults.site("fleet.heartbeat")
+
+# heartbeats are fired from poll loops — a few quick retries beat a long
+# backoff that would itself age the heartbeat past max_age_ms
+_HEARTBEAT_POLICY = _retry.RetryPolicy(
+    base_delay=0.05, max_delay=0.5, max_attempts=3, retry_on=(OSError,))
 
 
 def _barrier_label(name: str) -> str:
@@ -103,12 +117,11 @@ class Fleet:
                 jax_ep = (self._role.jax_coord_endpoint()
                           or f"{host}:{port + 1}")
                 if self._role.is_first_worker():
-                    self._client.put("fleet/jax_coordinator",
-                                     jax_ep.encode())
+                    self.put("fleet/jax_coordinator", jax_ep.encode())
                 else:
-                    jax_ep = self._client.get(
-                        "fleet/jax_coordinator",
-                        timeout_ms=connect_timeout_ms,
+                    jax_ep = _kv_get_retry(
+                        self._client, "fleet/jax_coordinator",
+                        connect_timeout_ms,
                     ).decode()
                 self._client.barrier("fleet/rendezvous", n)
 
@@ -169,7 +182,16 @@ class Fleet:
     def put(self, key: str, value: bytes):
         if self._client is None:
             raise RuntimeError("fleet.init with multiple workers first")
-        self._client.put(key, value)
+        from paddle_tpu import flags as _flags
+
+        client = self._client
+
+        def _once():
+            _F_KV_PUT.hit()
+            client.put(key, value)
+
+        _retry.call(_once, site="fleet.kv_put", retry_on=(OSError,),
+                    deadline_s=_flags.get_flag("rpc_deadline_ms") / 1000.0)
 
     def get(self, key: str, timeout_ms: Optional[int] = None) -> bytes:
         if self._client is None:
@@ -181,13 +203,21 @@ class Fleet:
         # a blocked KV get is the classic "peer never published its key"
         # hang (e.g. waiting out a partner's multi-minute first compile)
         with _monitor.stall_guard("fleet.kv_get"):
-            return self._client.get(key, timeout_ms=timeout_ms)
+            return _kv_get_retry(self._client, key, timeout_ms)
 
     # --- failure detection (SURVEY.md section 5) ---
 
     def heartbeat(self):
         if self._client is not None:
-            self._client.heartbeat(f"worker-{self.worker_index()}")
+            client = self._client
+            me = self.worker_index()
+
+            def _once():
+                _F_HEARTBEAT.hit()
+                client.heartbeat(f"worker-{me}")
+
+            _retry.call(_once, site="fleet.heartbeat",
+                        policy=_HEARTBEAT_POLICY)
 
     def dead_workers(self, max_age_ms: int = 30_000) -> Sequence[str]:
         if self._client is None:
@@ -332,18 +362,66 @@ class DistributedOptimizer:
 
 
 def _connect_retry(host: str, port: int, timeout_ms: int):
-    import time
-
+    """Retry-connect under the unified policy (exponential backoff +
+    decorrelated jitter, deadline budget) — replaces the fixed 0.1 s
+    spin. Workers poll here until rank 0's server is up."""
     from paddle_tpu import native
 
-    deadline = time.monotonic() + timeout_ms / 1000.0
-    while True:
-        try:
-            return native.CoordClient(host, port)
-        except OSError:
-            if time.monotonic() > deadline:
-                raise
-            time.sleep(0.1)
+    def _once():
+        _F_CONNECT.hit()
+        return native.CoordClient(host, port)
+
+    return _retry.call(_once, site="fleet.connect", retry_on=(OSError,),
+                       deadline_s=timeout_ms / 1000.0)
+
+
+# between kv-get attempts the real waiting happens SERVER-side (the
+# growing slice below) — the client-side sleep is kept tiny so a key
+# published during a slice is served the instant it lands, not after a
+# multi-second backoff nap
+_KV_GAP_POLICY = _retry.RetryPolicy(
+    base_delay=0.002, max_delay=0.02, retry_on=(OSError,))
+
+
+def _kv_get_retry(client, key: str, timeout_ms: int) -> bytes:
+    """KV get under the retry policy: server-side wait slices that grow
+    exponentially from ``retry_base_delay_ms`` up to
+    ``retry_max_delay_ms`` (instant wakeup when the key is published —
+    the server holds the request), with only millisecond client-side
+    gaps between attempts, raising TimeoutError once the overall
+    ``timeout_ms`` budget is spent. ``timeout_ms`` < 0 = block forever
+    (one server-side wait, no retry loop)."""
+    from paddle_tpu import flags as _flags
+
+    if timeout_ms is not None and timeout_ms <= 0:
+        # -1 = block forever; 0 = one non-blocking present-check — both
+        # are single passthrough calls, no retry loop (a 0 budget must
+        # still ASK the server, not synthesize a timeout)
+        _F_KV_GET.hit()
+        return client.get(key, timeout_ms=int(timeout_ms))
+    base_ms = max(1, _flags.get_flag("retry_base_delay_ms"))
+    cap_ms = max(base_ms, _flags.get_flag("retry_max_delay_ms"))
+    deadline = _time.monotonic() + timeout_ms / 1000.0
+    state = {"slice": base_ms}
+
+    def _once():
+        _F_KV_GET.hit()
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:  # same float compare retry.call makes below
+            raise TimeoutError(
+                f"coord get {key!r}: {timeout_ms} ms budget spent")
+        s = min(state["slice"], max(1, int(remaining * 1000)))
+        state["slice"] = min(state["slice"] * 2, cap_ms)
+        return client.get(key, timeout_ms=s)
+
+    # the SAME absolute deadline governs _once's budget check and the
+    # retry loop: when _once raises the budget-spent TimeoutError,
+    # retry.call sees remaining <= 0 on the same clock and converts it
+    # to a terminal 'exhausted' raise instead of one more retry cycle
+    return _retry.call(
+        _once, site="fleet.kv_get", retry_on=(OSError,),
+        deadline_at=deadline, policy=_KV_GAP_POLICY,
+    )
 
 
 fleet = Fleet()

@@ -13,12 +13,18 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu import faults as _faults
 from paddle_tpu.executor import Executor, Scope, global_scope
 from paddle_tpu.framework import Program, Variable, default_main_program
+
+# chaos hook between the export's metadata and parameter writes — the
+# window whose partial state load_inference_model used to die on
+_F_EXPORT = _faults.site("io.export")
 
 _PARAMS_FILE = "__params__.npz"
 _MODEL_FILE = "__model__"
@@ -157,11 +163,34 @@ def save_inference_model(
     export_for_deployment: bool = True,
 ):
     """(reference: io.py:903) Saves pruned ProgramDesc + params + feed/fetch
-    metadata."""
+    metadata.
+
+    Crash consistency: the export is STAGED into ``<dirname>.tmp`` and
+    published by rename only once every file (model, meta, params) is on
+    disk — a crash mid-export leaves either the previous complete export
+    or no ``dirname`` at all, never a directory that
+    ``load_inference_model`` starts loading and then dies on. The export
+    OWNS ``dirname``: re-exporting replaces the whole directory (files a
+    caller dropped alongside the artifacts do not survive), and a crash
+    in the brief swap window parks the previous export at
+    ``<dirname>.old.tmp``, from which the next export restores it."""
     program = main_program or default_main_program()
     pruned = _prune_for_inference(program, feeded_var_names, target_vars)
-    os.makedirs(dirname, exist_ok=True)
-    with open(os.path.join(dirname, model_filename or _MODEL_FILE), "wb") as f:
+    base = dirname.rstrip("/\\")
+    stage, old = base + ".tmp", base + ".old.tmp"
+    if not os.path.isdir(dirname) and os.path.isdir(old):
+        # a previous export crashed between the two publish renames;
+        # bring the complete old export back before replacing it (a
+        # concurrent recoverer may win the rename — that is fine)
+        try:
+            os.rename(old, dirname)
+        except OSError:
+            pass
+    if os.path.isdir(stage):  # leftover of an earlier crashed export
+        shutil.rmtree(stage)
+    os.makedirs(stage)
+    model_path = os.path.join(stage, model_filename or _MODEL_FILE)
+    with open(model_path, "wb") as f:
         f.write(pruned.desc_str())
     meta = {
         "feed_names": list(feeded_var_names),
@@ -169,9 +198,36 @@ def save_inference_model(
             v.name if isinstance(v, Variable) else str(v) for v in target_vars
         ],
     }
-    with open(os.path.join(dirname, _META_FILE), "w") as f:
+    with open(os.path.join(stage, _META_FILE), "w") as f:
         json.dump(meta, f)
-    save_persistables(executor, dirname, pruned, filename=params_filename)
+    # the model-written/params-missing window (path enables truncate
+    # plans to tear the staged __model__)
+    _F_EXPORT.hit(path=model_path)
+    save_persistables(executor, stage, pruned, filename=params_filename)
+    # durability before publish (same discipline as the checkpoint
+    # commit protocol): a rename can land on disk before the staged
+    # file DATA does, which would publish a dir of empty files
+    from paddle_tpu.parallel.checkpoint import _fsync_dir, _fsync_file
+
+    for fn in os.listdir(stage):
+        _fsync_file(os.path.join(stage, fn))
+    # publish: swap the staged dir in (atomic when dirname is absent; a
+    # pre-existing export is moved aside first, then dropped). Retried
+    # once: a concurrent loader's .old.tmp recovery can recreate
+    # dirname between the two renames — the new export must win, not
+    # crash out and be discarded.
+    for attempt in range(2):
+        if os.path.isdir(dirname):
+            shutil.rmtree(old, ignore_errors=True)
+            os.rename(dirname, old)
+        try:
+            os.rename(stage, dirname)
+            break
+        except OSError:
+            if attempt:
+                raise
+    _fsync_dir(os.path.dirname(base) or ".")
+    shutil.rmtree(old, ignore_errors=True)
     return meta["fetch_names"]
 
 
@@ -181,7 +237,24 @@ def load_inference_model(
     model_filename: Optional[str] = None,
     params_filename: Optional[str] = None,
 ):
-    """(reference: io.py:1083) -> (program, feed_names, fetch_vars)."""
+    """(reference: io.py:1083) -> (program, feed_names, fetch_vars).
+
+    Also recovers an export stranded at ``<dirname>.old.tmp`` by a crash
+    in ``save_inference_model``'s publish-swap window — a serving-only
+    host must not stay unloadable until some future export runs."""
+    base = dirname.rstrip("/\\")
+    if not os.path.isdir(dirname) and os.path.isdir(base + ".old.tmp"):
+        # a LIVE exporter's publish swap also passes through this state
+        # for a few microseconds — give it a beat before concluding the
+        # parked copy is a crash leftover to recover
+        import time as _t
+
+        _t.sleep(0.05)
+        if not os.path.isdir(dirname):
+            try:
+                os.rename(base + ".old.tmp", dirname)
+            except OSError:
+                pass  # a concurrent loader/exporter recovered it first
     with open(os.path.join(dirname, model_filename or _MODEL_FILE), "rb") as f:
         program = Program.parse_from_string(f.read())
     with open(os.path.join(dirname, _META_FILE)) as f:

@@ -1,4 +1,4 @@
-"""Sharded, optionally-async checkpointing of training state.
+"""Sharded, optionally-async, crash-consistent checkpointing.
 
 The TPU-native replacement for the reference's distributed checkpointing,
 where parameters sliced across pservers are saved per-server and re-merged
@@ -8,7 +8,8 @@ contrib/trainer.py:100,580). Here the unit is a sharded ``jax.Array``:
 
 - each PROCESS writes only its addressable shards (one ``.npz`` per
   process) plus a shared JSON manifest of {name -> shape, dtype, shard
-  index ranges}, so multi-host saves never gather the model onto one host;
+  index ranges, per-array crc32}, so multi-host saves never gather the
+  model onto one host;
 - restore reassembles the global value from shard files and places it
   back in the scope (host numpy); the next ``exe.run`` re-shards it
   according to the program's in_shardings, so training resumes bit-exact
@@ -19,22 +20,95 @@ contrib/trainer.py:100,580). Here the unit is a sharded ``jax.Array``:
   overlapping serialization with the next training steps (the orbax
   async-checkpoint pattern).
 
-Checkpoints are serial-numbered directories ``checkpoint_<step>`` with a
-``latest`` pointer file, like the reference Trainer's serial dirs.
+Crash-consistent commit protocol (the orbax commit-marker pattern)::
+
+    write  checkpoint_<N>.tmp/shards_<pid>.npz      (fsync)
+    write  checkpoint_<N>.tmp/manifest.json.<pid>   (fsync)
+    write  checkpoint_<N>.tmp/COMMIT                (fsync)
+    rename checkpoint_<N>.tmp -> checkpoint_<N>     (atomic publish)
+    write  latest.tmp; rename -> latest             (atomic pointer)
+
+A crash at ANY point leaves either a ``.tmp`` staging dir (ignored by
+``available_steps``/``latest_step``) or a fully committed serial: resume
+can never observe a half-written checkpoint. ``validate_checkpoint``
+additionally proves integrity (COMMIT marker, every manifest-referenced
+shard present, crc32 match), and ``latest_step`` skips invalid serials —
+counting them into ``pt_ckpt_invalid_skipped_total`` — falling back to
+the newest valid one. Single-host the protocol is complete; multi-host
+commits still need an external barrier before process 0 publishes
+(late non-zero writers land their files in the committed dir).
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import shutil
 import threading
+import time as _time
+import warnings
+import zlib
 from typing import Dict, List, Optional
 
 import jax
 import numpy as np
 
+from paddle_tpu import faults as _faults
+from paddle_tpu import monitor as _monitor
+
 _MANIFEST = "manifest.json"
 _LATEST = "latest"
+_COMMIT = "COMMIT"
+_STAGING_SUFFIX = ".tmp"
+
+_M_COMMIT_S = _monitor.histogram(
+    "pt_ckpt_commit_seconds",
+    "checkpoint commit-protocol duration (COMMIT marker -> published "
+    "latest pointer)")
+_M_INVALID_SKIPS = _monitor.counter(
+    "pt_ckpt_invalid_skipped_total",
+    "uncommitted/corrupt checkpoint serials skipped while resolving the "
+    "newest valid one")
+_M_ASYNC_ERRS = _monitor.counter(
+    "pt_ckpt_async_errors_total",
+    "background checkpoint-save failures surfaced outside wait()")
+
+_F_WRITE = _faults.site("ckpt.write_shards")
+_F_COMMIT = _faults.site("ckpt.commit")
+
+
+def _fsync_dir(path: str):
+    """Durably record a rename/create in its parent directory."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass  # some filesystems refuse dir fsync; rename is still atomic
+    finally:
+        os.close(fd)
+
+
+def _fsync_file(path: str):
+    """Flush an already-written file's data to disk (read-only open —
+    shared by the inference-export publish path)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _checksum(a: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(a).tobytes())
 
 
 def _shard_slices(arr) -> List[dict]:
@@ -50,15 +124,70 @@ def _shard_slices(arr) -> List[dict]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# async handles: a failed background save must never vanish
+# ---------------------------------------------------------------------------
+
+_HANDLES_LOCK = threading.Lock()
+_async_handles: List["_AsyncHandle"] = []
+
+
 class _AsyncHandle:
-    def __init__(self):
+    __slots__ = ("_thread", "error", "step", "_surfaced")
+
+    def __init__(self, step: int):
         self._thread: Optional[threading.Thread] = None
         self.error: Optional[BaseException] = None
+        self.step = step
+        self._surfaced = False
+
+    def done(self) -> bool:
+        # ident is None until the thread starts — and is_alive() is
+        # False then too, so without the ident check a reap racing the
+        # handle's registration would drop it (and its eventual error)
+        t = self._thread
+        return t is not None and t.ident is not None and not t.is_alive()
 
     def wait(self):
-        self._thread.join()
+        """Join the background write; raises its error. Idempotent —
+        safe to call any number of times (each call re-raises a stored
+        error rather than losing it)."""
+        t = self._thread
+        if t is not None:
+            t.join()
+        self._surfaced = True
         if self.error is not None:
             raise self.error
+
+
+def _reap_async(final: bool = False):
+    """Surface errors of finished handles nobody ``wait()``ed (called at
+    every save and at exit, so a failed background save is loud at most
+    one save later). ``final`` joins still-running writers first."""
+    with _HANDLES_LOCK:
+        handles = list(_async_handles)
+    for h in handles:
+        if final and h._thread is not None:
+            h._thread.join(timeout=30.0)
+        if not h.done():
+            continue
+        with _HANDLES_LOCK:
+            if h in _async_handles:
+                _async_handles.remove(h)
+        if h.error is not None and not h._surfaced:
+            h._surfaced = True
+            _M_ASYNC_ERRS.inc()
+            warnings.warn(
+                f"async checkpoint save (step {h.step}) failed and was "
+                f"never wait()ed: {h.error!r}", RuntimeWarning)
+
+
+atexit.register(_reap_async, final=True)
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
 
 
 def save_checkpoint(
@@ -67,15 +196,17 @@ def save_checkpoint(
     step: int = 0,
     async_save: bool = False,
 ):
-    """Write ``state`` (name -> array) to ``dirname/checkpoint_<step>``.
+    """Write ``state`` (name -> array) to ``dirname/checkpoint_<step>``
+    via the staging-dir commit protocol (module docstring).
 
     Sharded arrays: this process writes its addressable, replica-0 shards.
     Host numpy / replicated values: only process 0 writes. Returns an
     ``_AsyncHandle`` when ``async_save`` (call ``.wait()`` before relying
     on the files), else None.
     """
+    _reap_async()
     ckpt_dir = os.path.join(dirname, f"checkpoint_{step}")
-    os.makedirs(ckpt_dir, exist_ok=True)
+    stage_dir = ckpt_dir + _STAGING_SUFFIX
     pid = jax.process_index()
 
     manifest = {}
@@ -88,6 +219,7 @@ def save_checkpoint(
                 "dtype": str(v.dtype),
                 "sharded": True,
                 "shards": {},
+                "checksums": {},
             }
             slices = _shard_slices(v)
             for i, sh in enumerate(v.addressable_shards):
@@ -96,6 +228,7 @@ def save_checkpoint(
                 fkey = f"{key}::{pid}::{i}"
                 shard_payload[fkey] = np.asarray(sh.data)
                 entry["shards"][fkey] = slices[i]["index"]
+                entry["checksums"][fkey] = _checksum(shard_payload[fkey])
             manifest[name] = entry
         else:
             if pid == 0:
@@ -105,36 +238,140 @@ def save_checkpoint(
                     "dtype": str(shard_payload[key].dtype),
                     "sharded": False,
                     "file_key": key,
+                    "checksum": _checksum(shard_payload[key]),
                 }
 
     def _write():
-        np.savez(os.path.join(ckpt_dir, f"shards_{pid}.npz"),
-                 **shard_payload)
+        # a non-zero process arriving after process 0 already committed
+        # lands its files inside the published dir (multi-host saves
+        # still need an external pre-commit barrier; see docstring)
+        target = stage_dir
+        if pid != 0 and os.path.isdir(ckpt_dir):
+            target = ckpt_dir
+        os.makedirs(target, exist_ok=True)
+        shard_path = os.path.join(target, f"shards_{pid}.npz")
+        with open(shard_path, "wb") as f:
+            np.savez(f, **shard_payload)
+            f.flush()
+            os.fsync(f.fileno())
+        # chaos hook: raise here = crash after the (possibly partial)
+        # shard write, before commit; truncate = torn shard file
+        _F_WRITE.hit(path=shard_path)
         # every process writes its manifest fragment; fragments merge on
         # load (shard keys are globally unique)
-        with open(os.path.join(ckpt_dir, f"{_MANIFEST}.{pid}"), "w") as f:
+        with open(os.path.join(target, f"{_MANIFEST}.{pid}"), "w") as f:
             json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
         if pid == 0:
-            with open(os.path.join(dirname, _LATEST), "w") as f:
+            t0 = _time.perf_counter()
+            _F_COMMIT.hit()
+            with open(os.path.join(target, _COMMIT), "w") as f:
+                json.dump({"step": step, "format": 1}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            if target is stage_dir:
+                old_dir = ckpt_dir + ".old" + _STAGING_SUFFIX
+                # Re-save of the same serial: park the committed old
+                # copy aside instead of rmtree-before-replace — a crash
+                # in this window must not lose the only copy
+                # (_recover_displaced renames it back on discovery).
+                # Retried once because a concurrent reader's recovery
+                # can recreate ckpt_dir between the two renames; the
+                # new save must win, not fail with ENOTEMPTY.
+                for attempt in range(2):
+                    if os.path.isdir(ckpt_dir):
+                        shutil.rmtree(old_dir, ignore_errors=True)
+                        os.rename(ckpt_dir, old_dir)
+                    try:
+                        os.replace(stage_dir, ckpt_dir)
+                        break
+                    except OSError:
+                        if attempt:
+                            raise
+                shutil.rmtree(old_dir, ignore_errors=True)
+            _fsync_dir(dirname)
+            latest_tmp = os.path.join(dirname, _LATEST + _STAGING_SUFFIX)
+            with open(latest_tmp, "w") as f:
                 f.write(str(step))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(latest_tmp, os.path.join(dirname, _LATEST))
+            _fsync_dir(dirname)
+            _M_COMMIT_S.observe(_time.perf_counter() - t0)
+            _sweep_stale_staging(dirname, step)
 
     if async_save:
-        handle = _AsyncHandle()
+        handle = _AsyncHandle(step)
 
         def _run():
             try:
                 _write()
-            except BaseException as e:  # surfaced by wait()
+            except BaseException as e:  # surfaced by wait() / next reap
                 handle.error = e
 
         handle._thread = threading.Thread(target=_run, daemon=True)
+        with _HANDLES_LOCK:
+            _async_handles.append(handle)
         handle._thread.start()
         return handle
     _write()
     return None
 
 
-def latest_step(dirname: str) -> Optional[int]:
+# ---------------------------------------------------------------------------
+# discovery + validation
+# ---------------------------------------------------------------------------
+
+
+def _sweep_stale_staging(dirname: str, committed_step: int):
+    """Garbage-collect `.tmp` staging dirs left by CRASHED saves of
+    older serials (a crashed save of THIS serial was replaced above)
+    and `.old.tmp` parked copies whose serial exists again. Staging
+    dirs of in-flight async saves are left alone."""
+    import re
+
+    with _HANDLES_LOCK:
+        live = {h.step for h in _async_handles if not h.done()}
+    try:
+        entries = os.listdir(dirname)
+    except OSError:
+        return
+    for d in entries:
+        m = re.match(r"checkpoint_(\d+)\.tmp$", d)
+        if m and int(m.group(1)) < committed_step \
+                and int(m.group(1)) not in live:
+            shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+            continue
+        m = re.match(r"checkpoint_(\d+)\.old\.tmp$", d)
+        if m and os.path.isdir(
+                os.path.join(dirname, f"checkpoint_{m.group(1)}")):
+            shutil.rmtree(os.path.join(dirname, d), ignore_errors=True)
+
+
+def _recover_displaced(dirname: str):
+    """Crash recovery for the re-save window: a serial parked at
+    ``checkpoint_<n>.old.tmp`` whose main dir is missing was displaced
+    by a save that died before publishing — rename the committed copy
+    back so discovery sees it again."""
+    import re
+
+    try:
+        entries = os.listdir(dirname)
+    except OSError:
+        return
+    for d in entries:
+        m = re.match(r"checkpoint_(\d+)\.old\.tmp$", d)
+        if m:
+            main = os.path.join(dirname, f"checkpoint_{m.group(1)}")
+            if not os.path.isdir(main):
+                try:
+                    os.rename(os.path.join(dirname, d), main)
+                except OSError:
+                    pass
+
+
+def _pointer_step(dirname: str) -> Optional[int]:
     try:
         with open(os.path.join(dirname, _LATEST)) as f:
             return int(f.read().strip())
@@ -148,7 +385,7 @@ def available_steps(dirname: str) -> List[int]:
     out = []
     try:
         for d in os.listdir(dirname):
-            m = re.match(r"checkpoint_(\d+)$", d)
+            m = re.match(r"checkpoint_(\d+)$", d)  # excludes .tmp staging
             if m:
                 out.append(int(m.group(1)))
     except OSError:
@@ -156,38 +393,115 @@ def available_steps(dirname: str) -> List[int]:
     return sorted(out)
 
 
+def validate_checkpoint(dirname: str, step: int,
+                        verify_checksums: bool = True) -> bool:
+    """True iff ``checkpoint_<step>`` is committed and internally
+    consistent: COMMIT marker present and parseable, manifest fragments
+    parse, every referenced shard key exists in the shard files, and
+    (by default) every array's crc32 matches its manifest record.
+
+    Legacy tolerance: dirs written BEFORE the commit protocol carry no
+    COMMIT marker — they are accepted when structurally complete (the
+    new protocol never leaves a markerless final-named dir, so a
+    missing marker can only mean pre-plane format; a markerless dir
+    torn by an old-style crash still fails the structural checks)."""
+    ckpt_dir = os.path.join(dirname, f"checkpoint_{step}")
+    try:
+        marker = os.path.join(ckpt_dir, _COMMIT)
+        if os.path.exists(marker):
+            with open(marker) as f:
+                json.load(f)
+        elif not os.path.isdir(ckpt_dir):
+            return False
+        manifest, payload = _read_raw(ckpt_dir,
+                                      load_payload=verify_checksums)
+        if not manifest:
+            return False
+        for name, entry in manifest.items():
+            if entry.get("sharded"):
+                keys = list(entry["shards"])
+                sums = entry.get("checksums", {})
+            else:
+                keys = [entry["file_key"]]
+                sums = {entry["file_key"]: entry.get("checksum")}
+            for k in keys:
+                if k not in payload:
+                    return False
+                want = sums.get(k) if verify_checksums else None
+                if want is not None and _checksum(payload[k]) != want:
+                    return False
+        return True
+    except Exception:  # noqa: BLE001 — any torn-file failure = invalid
+        return False
+
+
+def latest_step(dirname: str,
+                verify_checksums: bool = True) -> Optional[int]:
+    """Newest VALID committed serial, scanning the serial dirs on disk
+    newest-first — NOT the ``latest`` pointer, which can be one step
+    stale (a crash between the dir rename and the pointer update leaves
+    a fully committed serial the pointer doesn't name yet; the pointer
+    file remains as a cheap human-readable hint). Serials that fail
+    validation count into ``pt_ckpt_invalid_skipped_total`` (one count
+    per skip EVENT, not per distinct serial) and are skipped.
+
+    COST: the default full verification reads every candidate's arrays
+    to prove their crc32s — the honest "is this resumable" answer. Pass
+    ``verify_checksums=False`` for a cheap structural probe (npz name
+    indexes only), or use ``load_latest`` when the values are needed
+    anyway (single read)."""
+    _recover_displaced(dirname)
+    for s in reversed(available_steps(dirname)):
+        if validate_checkpoint(dirname, s, verify_checksums):
+            return s
+        _M_INVALID_SKIPS.inc()
+    return None
+
+
+# ---------------------------------------------------------------------------
+# load
+# ---------------------------------------------------------------------------
+
+
+def load_latest(dirname: str):
+    """``(step, {name -> array})`` of the newest loadable serial, or
+    None. Single-pass: each candidate (newest first) is loaded
+    directly — ``_load_one`` verifies shard coverage and crc32 in the
+    same read, so resume never reads a multi-GB checkpoint twice.
+    Markerless pre-plane dirs load like any other (the structural
+    checks reject torn ones; see validate_checkpoint). Unloadable
+    serials count into ``pt_ckpt_invalid_skipped_total``."""
+    _recover_displaced(dirname)
+    for s in reversed(available_steps(dirname)):
+        try:
+            return s, _load_one(dirname, s)
+        except Exception:  # noqa: BLE001 — torn/corrupt: try the next
+            _M_INVALID_SKIPS.inc()
+    return None
+
+
 def load_checkpoint(dirname: str, step: Optional[int] = None) -> Dict[str, np.ndarray]:
     """Reassemble {name -> full numpy array} from all processes' shard
-    files of ``checkpoint_<step>`` (default: the ``latest`` pointer).
-
-    Default-load resilience: multi-host saves have no cross-host commit
-    barrier (process 0 publishes ``latest`` after writing only ITS files),
-    so if the newest checkpoint is incomplete — a preemption hit mid-save —
-    older serials are tried before giving up."""
+    files of ``checkpoint_<step>`` (default: the newest VALID serial —
+    uncommitted or corrupt newer ones are skipped, so a crash mid-save
+    falls back to the previous committed checkpoint)."""
     if step is not None:
         return _load_one(dirname, step)
-    latest = latest_step(dirname)
-    if latest is None:
-        raise FileNotFoundError(f"no 'latest' pointer in {dirname}")
-    candidates = [latest] + [
-        s for s in reversed(available_steps(dirname)) if s != latest
-    ]
-    last_err: Optional[Exception] = None
-    for s in candidates:
-        try:
-            return _load_one(dirname, s)
-        except Exception as e:  # noqa: BLE001 — any torn-file failure
-            # (missing files, truncated npz -> BadZipFile, cut-off JSON)
-            # means "this serial is incomplete, try the next one"
-            last_err = e
-    raise IOError(
-        f"no complete checkpoint in {dirname} "
-        f"(tried {candidates}): {last_err}"
-    )
+    loaded = load_latest(dirname)
+    if loaded is None:
+        if _pointer_step(dirname) is None and not available_steps(dirname):
+            raise FileNotFoundError(f"no checkpoint in {dirname}")
+        raise IOError(
+            f"no valid committed checkpoint in {dirname} "
+            f"(serials on disk: {available_steps(dirname)})")
+    return loaded[1]
 
 
-def _load_one(dirname: str, step: int) -> Dict[str, np.ndarray]:
-    ckpt_dir = os.path.join(dirname, f"checkpoint_{step}")
+def _read_raw(ckpt_dir: str, load_payload: bool = True):
+    """(merged manifest, {file key -> array}) straight off disk. With
+    ``load_payload=False`` the payload maps every key present in the
+    npz indexes to None (header read only — no array data), which is
+    what structural validation needs."""
     manifest: Dict[str, dict] = {}
     for fn in sorted(os.listdir(ckpt_dir)):
         if fn.startswith(_MANIFEST):
@@ -196,24 +510,52 @@ def _load_one(dirname: str, step: int) -> Dict[str, np.ndarray]:
             for name, entry in frag.items():
                 if name in manifest and entry.get("sharded"):
                     manifest[name]["shards"].update(entry["shards"])
+                    manifest[name].setdefault("checksums", {}).update(
+                        entry.get("checksums", {}))
                 else:
                     manifest.setdefault(name, entry)
 
-    payload: Dict[str, np.ndarray] = {}
+    payload: Dict[str, Optional[np.ndarray]] = {}
     for fn in sorted(os.listdir(ckpt_dir)):
         if fn.startswith("shards_") and fn.endswith(".npz"):
             with np.load(os.path.join(ckpt_dir, fn)) as z:
-                for k in z.files:
-                    payload[k] = z[k]
+                if load_payload:
+                    for k in z.files:
+                        payload[k] = z[k]
+                else:
+                    payload.update(dict.fromkeys(z.files))
+    return manifest, payload
+
+
+def _load_one(dirname: str, step: int) -> Dict[str, np.ndarray]:
+    ckpt_dir = os.path.join(dirname, f"checkpoint_{step}")
+    manifest, payload = _read_raw(ckpt_dir)
+    if not manifest:
+        # an empty/foreign dir must not load as (step, {}) — resume
+        # would pick it over an older REAL checkpoint and then die on
+        # the missing-parameters check instead of falling back
+        raise IOError(f"checkpoint_{step}: no manifest fragments")
 
     out: Dict[str, np.ndarray] = {}
     for name, entry in manifest.items():
         if not entry["sharded"]:
-            out[name] = payload[entry["file_key"]]
+            k = entry["file_key"]
+            want = entry.get("checksum")
+            if want is not None and _checksum(payload[k]) != want:
+                raise IOError(
+                    f"checkpoint_{step}: checksum mismatch for '{name}' "
+                    f"— corrupt shard file")
+            out[name] = payload[k]
             continue
         full = np.zeros(entry["shape"], dtype=np.dtype(entry["dtype"]))
         seen = np.zeros(entry["shape"], dtype=bool)
+        sums = entry.get("checksums", {})
         for fkey, index in entry["shards"].items():
+            want = sums.get(fkey)
+            if want is not None and _checksum(payload[fkey]) != want:
+                raise IOError(
+                    f"checkpoint_{step}: checksum mismatch for shard "
+                    f"'{fkey}' of '{name}' — corrupt shard file")
             sl = tuple(slice(a, b) for a, b in index)
             full[sl] = payload[fkey]
             seen[sl] = True

@@ -1,0 +1,146 @@
+"""Unified retry/backoff policy for coordination-plane calls.
+
+Replaces the ad-hoc fixed-sleep loops (fleet ``_connect_retry``'s 0.1 s
+spin) with one policy object: exponential backoff with *decorrelated
+jitter* (sleep_n drawn uniformly from [base, 3*sleep_{n-1}], capped —
+the AWS-architecture variant that avoids thundering synchronized
+retries across a fleet) under a hard *deadline budget*, so a retried
+call fails at its deadline rather than after a fixed attempt count.
+
+Per-site defaults come from the ``retry_base_delay_ms`` /
+``retry_max_delay_ms`` / ``retry_max_attempts`` flags; callers pass a
+deadline (usually their ``timeout_ms``) and the exception types worth
+retrying. Every retry/give-up counts into
+``pt_retry_total{site=,outcome=}`` (outcome: ``retry`` per re-attempt,
+``success`` when a retried call eventually lands, ``exhausted`` when
+the deadline/attempt budget runs out).
+
+For deterministic tests, pass ``rng=random.Random(seed)`` and/or
+monkeypatch ``retry._sleep``.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from paddle_tpu import flags as _flags
+from paddle_tpu import monitor as _monitor
+
+_M_RETRY = _monitor.counter(
+    "pt_retry_total",
+    "retry-policy events, by call site and outcome "
+    "(retry / success-after-retry / exhausted)")
+
+# monkeypatch point for deterministic tests (and the only sleep used)
+_sleep = time.sleep
+
+_DEFAULT_RETRY_ON: Tuple[Type[BaseException], ...] = (OSError, TimeoutError)
+
+
+class RetryPolicy:
+    """Backoff parameters; stateless across calls (each ``call`` keeps
+    its own attempt counter and sleep history)."""
+
+    __slots__ = ("base_delay", "max_delay", "max_attempts", "retry_on")
+
+    def __init__(
+        self,
+        base_delay: float = 0.1,
+        max_delay: float = 5.0,
+        max_attempts: int = 0,
+        retry_on: Tuple[Type[BaseException], ...] = _DEFAULT_RETRY_ON,
+    ):
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.max_attempts = int(max_attempts)  # 0 = deadline-only
+        self.retry_on = retry_on
+
+    def next_sleep(self, prev: Optional[float],
+                   rng: Optional[random.Random] = None) -> float:
+        """Decorrelated jitter: uniform in [base, 3*prev], capped."""
+        if prev is None:
+            return min(self.base_delay, self.max_delay)
+        r = rng.uniform if rng is not None else random.uniform
+        return min(self.max_delay, r(self.base_delay, prev * 3))
+
+
+_default_policy: Optional[RetryPolicy] = None
+
+
+def default_policy() -> RetryPolicy:
+    """The flag-configured policy (rebuilt on flag change)."""
+    global _default_policy
+    if _default_policy is None:
+        _default_policy = RetryPolicy(
+            base_delay=_flags.get_flag("retry_base_delay_ms") / 1000.0,
+            max_delay=_flags.get_flag("retry_max_delay_ms") / 1000.0,
+            max_attempts=_flags.get_flag("retry_max_attempts"),
+        )
+    return _default_policy
+
+
+def _invalidate_default(_value=None):
+    global _default_policy
+    _default_policy = None
+
+
+for _name in ("retry_base_delay_ms", "retry_max_delay_ms",
+              "retry_max_attempts"):
+    _flags.watch_flag(_name, _invalidate_default)
+
+
+def call(
+    fn: Callable,
+    *,
+    site: str,
+    policy: Optional[RetryPolicy] = None,
+    deadline_s: Optional[float] = None,
+    retry_on: Optional[Tuple[Type[BaseException], ...]] = None,
+    rng: Optional[random.Random] = None,
+    deadline_at: Optional[float] = None,
+):
+    """Run ``fn()`` under the retry policy.
+
+    Retries exceptions in ``retry_on`` (default: the policy's) with
+    backoff until EITHER the attempt cap is hit OR the deadline budget
+    (``deadline_s`` seconds from now, or the absolute
+    ``time.monotonic()`` instant ``deadline_at`` — pass the latter when
+    ``fn`` checks the SAME deadline itself, so both sides agree to the
+    tick; None = unbounded) is exceeded — then the last exception
+    propagates. A first-try success is the no-overhead path: no sleep,
+    no metric, no allocation here.
+    """
+    p = policy if policy is not None else default_policy()
+    if retry_on is None:
+        retry_on = p.retry_on
+    deadline = deadline_at if deadline_at is not None else (
+        time.monotonic() + deadline_s if deadline_s is not None else None)
+    attempt = 0
+    prev_sleep = None
+    while True:
+        try:
+            result = fn()
+        except retry_on as e:
+            attempt += 1
+            if p.max_attempts and attempt >= p.max_attempts:
+                _M_RETRY.inc(labels={"site": site, "outcome": "exhausted"})
+                raise
+            prev_sleep = p.next_sleep(prev_sleep, rng)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    _M_RETRY.inc(
+                        labels={"site": site, "outcome": "exhausted"})
+                    raise
+                # never sleep past the deadline; the final attempt runs
+                # with whatever budget is left
+                prev_sleep = min(prev_sleep, remaining)
+            _M_RETRY.inc(labels={"site": site, "outcome": "retry"})
+            _sleep(prev_sleep)
+            del e
+        else:
+            if attempt:
+                _M_RETRY.inc(labels={"site": site, "outcome": "success"})
+            return result

@@ -1,16 +1,28 @@
 """Sharded checkpoint/resume tests (reference: distributed persistables
 re-merge io.py:282,315-360; Trainer serial checkpoint dirs
 contrib/trainer.py:100). Acceptance: restore resumes training bit-exact
-on a TP-sharded model over the 8-device mesh."""
+on a TP-sharded model over the 8-device mesh; a crash at ANY point of a
+save (exercised via injected faults) leaves resume on the previous
+valid committed serial."""
+
+import os
 
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
 import paddle_tpu as fluid
-from paddle_tpu import layers
+from paddle_tpu import faults, flags, layers, monitor
 from paddle_tpu.parallel import checkpoint as ckpt
 from paddle_tpu.parallel.strategy import DistributedStrategy, ShardingRule
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    faults.disarm()
+    yield
+    faults.disarm()
+    flags.set_flags({"telemetry": False})
 
 
 def _build():
@@ -172,3 +184,367 @@ def test_truncated_shard_file_falls_back(tmp_path):
                 f.truncate(20)  # torn write
     vals = ckpt.load_checkpoint(str(tmp_path))
     assert vals  # fell back to checkpoint_1
+
+
+# --------------------------------------------------------------------------
+# crash-consistent commit protocol (ISSUE 5 tentpole)
+# --------------------------------------------------------------------------
+
+def _save_two(tmp_path):
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ckpt.save_scope(str(tmp_path), scope, step=1)
+        ckpt.save_scope(str(tmp_path), scope, step=2)
+    return scope
+
+
+def test_committed_dir_has_marker_and_no_staging_left(tmp_path):
+    _save_two(tmp_path)
+    assert (tmp_path / "checkpoint_2" / "COMMIT").exists()
+    assert not [d for d in os.listdir(str(tmp_path)) if d.endswith(".tmp")]
+    assert ckpt.validate_checkpoint(str(tmp_path), 2)
+    assert ckpt.validate_checkpoint(str(tmp_path), 2,
+                                    verify_checksums=False)
+    assert ckpt.latest_step(str(tmp_path)) == 2
+
+
+def test_crash_mid_shard_write_falls_back_bit_identical(tmp_path):
+    """Kill-mid-write via injected fault: the Nth checkpoint's shard
+    write crashes -> resume restores checkpoint N-1 bit-identically and
+    latest_step never returns the uncommitted dir (ISSUE 5 acceptance)."""
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ckpt.save_scope(str(tmp_path), scope, step=1)
+        before = {n: np.asarray(scope.find_var(n))
+                  for n in scope.var_names()}
+        # train one step so the in-memory state DIFFERS from checkpoint_1,
+        # then crash checkpoint_2's shard write
+        exe.run(fluid.CompiledProgram(main).with_strategy(_strategy()),
+                feed=_batches(1)[0], fetch_list=[loss])
+        faults.arm("ckpt.write_shards:raise@1")
+        with pytest.raises(faults.InjectedFault):
+            ckpt.save_scope(str(tmp_path), scope, step=2)
+        faults.disarm()
+    # the torn save left only a staging dir: not a serial, not latest
+    assert (tmp_path / "checkpoint_2.tmp").exists()
+    assert not (tmp_path / "checkpoint_2").exists()
+    assert ckpt.available_steps(str(tmp_path)) == [1]
+    assert ckpt.latest_step(str(tmp_path)) == 1
+    restored = ckpt.load_checkpoint(str(tmp_path))
+    assert set(restored) == set(before)
+    for n in before:  # bit-identical params on restore
+        np.testing.assert_array_equal(restored[n], before[n], err_msg=n)
+
+
+def test_crash_before_commit_marker_falls_back(tmp_path):
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ckpt.save_scope(str(tmp_path), scope, step=1)
+        faults.arm("ckpt.commit:raise@1")
+        with pytest.raises(faults.InjectedFault):
+            ckpt.save_scope(str(tmp_path), scope, step=2)
+    assert ckpt.latest_step(str(tmp_path)) == 1
+
+
+def test_truncated_shard_skipped_by_latest_step(tmp_path):
+    """latest_step must skip a committed-then-corrupted serial (torn by
+    an injected truncate fault) and count the skip."""
+    monitor.enable()
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ckpt.save_scope(str(tmp_path), scope, step=1)
+        faults.arm("ckpt.write_shards:truncate(24)@1")
+        ckpt.save_scope(str(tmp_path), scope, step=2)  # commits, but torn
+        faults.disarm()
+    assert (tmp_path / "checkpoint_2" / "COMMIT").exists()
+    skips0 = monitor.counter("pt_ckpt_invalid_skipped_total").value()
+    assert ckpt.latest_step(str(tmp_path)) == 1  # pointer said 2
+    assert monitor.counter("pt_ckpt_invalid_skipped_total").value() > skips0
+    vals = ckpt.load_checkpoint(str(tmp_path))
+    assert vals
+
+
+def test_bad_checksum_skipped_and_explicit_load_raises(tmp_path):
+    """Bit-rot: a shard file that unzips fine but whose array bytes no
+    longer match the manifest crc32 is skipped by latest_step; loading
+    it explicitly raises the checksum error."""
+    _save_two(tmp_path)
+    d = tmp_path / "checkpoint_2"
+    for fn in os.listdir(str(d)):
+        if fn.startswith("shards_"):
+            with np.load(str(d / fn)) as z:
+                data = {k: np.array(z[k]) for k in z.files}
+            k0 = sorted(data)[0]
+            flat = data[k0].reshape(-1)
+            flat[0] += 1.0  # silent corruption, still a valid npz
+            np.savez(str(d / fn), **data)  # fn ends in .npz: no suffixing
+            break
+    assert ckpt.latest_step(str(tmp_path)) == 1
+    with pytest.raises(IOError, match="checksum"):
+        ckpt.load_checkpoint(str(tmp_path), step=2)
+    vals = ckpt.load_checkpoint(str(tmp_path))  # falls back to 1
+    assert vals
+
+
+def test_stale_pointer_does_not_hide_newer_committed_serial(tmp_path):
+    """Crash between the serial-dir rename and the pointer update: the
+    committed serial must win over the stale pointer (code-review
+    finding — pointer-first ordering replayed a whole epoch)."""
+    _save_two(tmp_path)
+    with open(str(tmp_path / "latest"), "w") as f:
+        f.write("1")  # pointer never advanced past the crash
+    assert ckpt.latest_step(str(tmp_path)) == 2
+    assert ckpt.load_latest(str(tmp_path))[0] == 2
+
+
+def test_stale_staging_dirs_swept_at_next_commit(tmp_path):
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ckpt.save_scope(str(tmp_path), scope, step=1)
+        faults.arm("ckpt.write_shards:raise@1")
+        with pytest.raises(faults.InjectedFault):
+            ckpt.save_scope(str(tmp_path), scope, step=2)
+        faults.disarm()
+        assert (tmp_path / "checkpoint_2.tmp").exists()
+        ckpt.save_scope(str(tmp_path), scope, step=3)  # commit sweeps
+    assert not (tmp_path / "checkpoint_2.tmp").exists()
+    assert ckpt.latest_step(str(tmp_path)) == 3
+
+
+def test_all_serials_invalid_raises_ioerror(tmp_path):
+    _save_two(tmp_path)
+    for s in (1, 2):
+        for fn in os.listdir(str(tmp_path / f"checkpoint_{s}")):
+            if fn.startswith("shards_"):
+                with open(str(tmp_path / f"checkpoint_{s}" / fn),
+                          "r+b") as f:
+                    f.truncate(10)  # every serial torn
+    assert ckpt.latest_step(str(tmp_path)) is None
+    with pytest.raises(IOError):
+        ckpt.load_checkpoint(str(tmp_path))
+
+
+def test_empty_foreign_dir_skipped_not_loaded_as_empty(tmp_path):
+    """A manifest-less final-named dir (pre-plane crash debris, manual
+    mkdir) must be SKIPPED by load_latest, not returned as (step, {})
+    that out-shadows an older real checkpoint (code-review finding,
+    round 3)."""
+    _save_two(tmp_path)
+    os.makedirs(str(tmp_path / "checkpoint_9"))
+    assert ckpt.latest_step(str(tmp_path)) == 2
+    step, values = ckpt.load_latest(str(tmp_path))
+    assert step == 2 and values
+    with pytest.raises(IOError, match="manifest"):
+        ckpt.load_checkpoint(str(tmp_path), step=9)
+
+
+def test_legacy_dir_without_commit_marker_still_loads(tmp_path):
+    """Upgrade path (code-review finding, round 2): checkpoints written
+    BEFORE the commit protocol carry no COMMIT marker — they must stay
+    loadable (the new protocol never leaves a markerless final dir, so
+    a missing marker can only mean pre-plane format)."""
+    scope = _save_two(tmp_path)
+    for s in (1, 2):
+        os.remove(str(tmp_path / f"checkpoint_{s}" / "COMMIT"))
+    assert ckpt.latest_step(str(tmp_path)) == 2
+    assert ckpt.validate_checkpoint(str(tmp_path), 2)
+    step, values = ckpt.load_latest(str(tmp_path))
+    assert step == 2
+    for n in values:
+        np.testing.assert_array_equal(
+            values[n], np.asarray(scope.find_var(n)), err_msg=n)
+
+
+def test_displaced_serial_recovered_after_resave_crash(tmp_path):
+    """Crash in the re-save publish window parks the committed copy at
+    checkpoint_<n>.old.tmp — discovery renames it back (code-review
+    finding, round 2: rmtree-before-replace lost the only copy)."""
+    _save_two(tmp_path)
+    os.rename(str(tmp_path / "checkpoint_2"),
+              str(tmp_path / "checkpoint_2.old.tmp"))
+    assert ckpt.latest_step(str(tmp_path)) == 2  # recovered in place
+    assert (tmp_path / "checkpoint_2").exists()
+    assert not (tmp_path / "checkpoint_2.old.tmp").exists()
+    assert ckpt.load_latest(str(tmp_path))[0] == 2
+
+
+def test_resave_same_serial_replaces_it(tmp_path):
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ckpt.save_scope(str(tmp_path), scope, step=1)
+        exe.run(fluid.CompiledProgram(main).with_strategy(_strategy()),
+                feed=_batches(1)[0], fetch_list=[loss])
+        after = {n: np.asarray(scope.find_var(n))
+                 for n in scope.var_names()}
+        ckpt.save_scope(str(tmp_path), scope, step=1)  # overwrite serial
+    vals = ckpt.load_checkpoint(str(tmp_path), step=1)
+    for n in after:
+        np.testing.assert_array_equal(vals[n], after[n], err_msg=n)
+
+
+# --------------------------------------------------------------------------
+# async-save error surfacing (satellite: no silent loss)
+# --------------------------------------------------------------------------
+
+def test_async_save_error_surfaces_at_next_save_without_wait(tmp_path):
+    monitor.enable()
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        faults.arm("ckpt.write_shards:raise@1")
+        h = ckpt.save_scope(str(tmp_path), scope, step=1, async_save=True)
+        h._thread.join()  # let the background failure land (no wait())
+        faults.disarm()
+        errs0 = monitor.counter("pt_ckpt_async_errors_total").value()
+        with pytest.warns(RuntimeWarning, match="async checkpoint save"):
+            ckpt.save_scope(str(tmp_path), scope, step=2)
+        assert monitor.counter(
+            "pt_ckpt_async_errors_total").value() == errs0 + 1
+    assert ckpt.latest_step(str(tmp_path)) == 2
+
+
+def test_async_wait_is_idempotent_and_raises_each_time(tmp_path):
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        faults.arm("ckpt.write_shards:raise@1")
+        h = ckpt.save_scope(str(tmp_path), scope, step=1, async_save=True)
+        with pytest.raises(faults.InjectedFault):
+            h.wait()
+        with pytest.raises(faults.InjectedFault):
+            h.wait()  # idempotent: same answer, no deadlock
+        faults.disarm()
+        h2 = ckpt.save_scope(str(tmp_path), scope, step=2, async_save=True)
+        h2.wait()
+        h2.wait()  # success path equally idempotent
+    assert ckpt.latest_step(str(tmp_path)) == 2
+
+
+# --------------------------------------------------------------------------
+# trainer auto-resume + pruning order (satellites)
+# --------------------------------------------------------------------------
+
+def _trainer_pieces():
+    from paddle_tpu.contrib import EndStepEvent
+
+    def train_func():
+        img = layers.data("img", shape=[16], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(img, 32, act="relu",
+                      param_attr=fluid.ParamAttr(name="ar1.w"),
+                      bias_attr=fluid.ParamAttr(name="ar1.b"))
+        logits = layers.fc(h, 4,
+                           param_attr=fluid.ParamAttr(name="ar2.w"),
+                           bias_attr=fluid.ParamAttr(name="ar2.b"))
+        return [layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))]
+
+    def optimizer_func():
+        return fluid.optimizer.SGD(0.1)
+
+    def reader():
+        probe = np.random.RandomState(5).randn(16, 4)
+
+        def gen():
+            rng = np.random.RandomState(0)
+            for _ in range(4):
+                x = rng.randn(32, 16).astype(np.float32)
+                y = np.argmax(x @ probe, 1).astype(np.int64)
+                yield list(zip(x, y))
+
+        return gen
+
+    return train_func, optimizer_func, reader, EndStepEvent
+
+
+def test_trainer_auto_resumes_from_last_valid_checkpoint(tmp_path):
+    """Chaos regression (ISSUE 5 acceptance): a fault mid-training with
+    max_resume_retries restores the newest valid checkpoint and the
+    replayed epochs match the uninterrupted run."""
+    from paddle_tpu.contrib import CheckpointConfig, Trainer
+
+    monitor.enable()
+    train_func, optimizer_func, reader, EndStepEvent = _trainer_pieces()
+
+    ref = []
+    t_ref = Trainer(train_func, optimizer_func, fluid.CPUPlace(),
+                    checkpoint_config=CheckpointConfig(
+                        str(tmp_path / "ref"), epoch_interval=1))
+    t_ref.train(4, lambda e: ref.append(float(e.metrics[0]))
+                if isinstance(e, EndStepEvent) else None,
+                reader(), ["img", "label"])
+
+    # chaos run: the 10th batch fetch (epoch 3's 2nd batch, after
+    # checkpoint_2 committed) raises; one auto-resume allowed
+    chaos = []
+    faults.arm("reader.next:raise@10")
+    t = Trainer(train_func, optimizer_func, fluid.CPUPlace(),
+                checkpoint_config=CheckpointConfig(
+                    str(tmp_path / "chaos"), epoch_interval=1,
+                    max_resume_retries=1))
+    with pytest.warns(RuntimeWarning, match="auto-resuming"):
+        t.train(4, lambda e: chaos.append(float(e.metrics[0]))
+                if isinstance(e, EndStepEvent) else None,
+                reader(), ["img", "label"])
+    faults.disarm()
+    assert monitor.counter("pt_trainer_auto_resumes_total").value() == 1
+    from paddle_tpu.parallel import checkpoint as _ck
+    assert _ck.latest_step(str(tmp_path / "chaos")) == 4
+    # epochs 3-4 were replayed from checkpoint_2: their losses match the
+    # uninterrupted reference run exactly
+    assert len(chaos) > len(ref)  # epoch 3 ran once partially, then fully
+    np.testing.assert_allclose(ref[8:], chaos[-8:], rtol=1e-6)
+
+
+def test_trainer_resume_budget_exhausts_then_raises(tmp_path):
+    from paddle_tpu.contrib import CheckpointConfig, Trainer
+
+    train_func, optimizer_func, reader, _ = _trainer_pieces()
+    faults.arm("reader.next:raise@5,6,7")  # every epoch-2 start fails
+    t = Trainer(train_func, optimizer_func, fluid.CPUPlace(),
+                checkpoint_config=CheckpointConfig(
+                    str(tmp_path), epoch_interval=1, max_resume_retries=1))
+    with pytest.raises(faults.InjectedFault), \
+            pytest.warns(RuntimeWarning, match="auto-resuming"):
+        t.train(4, None, reader(), ["img", "label"])
+
+
+def test_trainer_never_prunes_the_last_valid_checkpoint(tmp_path):
+    """Pruning-order satellite: with max_num_checkpoints=1, a failed
+    save of serial N must leave serial N-1 on disk (the old prune-first
+    order could leave ZERO resumable state)."""
+    from paddle_tpu.contrib import CheckpointConfig, Trainer
+
+    train_func, optimizer_func, reader, _ = _trainer_pieces()
+    faults.arm("ckpt.commit:raise@2")  # epoch 2's save dies pre-commit
+    t = Trainer(train_func, optimizer_func, fluid.CPUPlace(),
+                checkpoint_config=CheckpointConfig(
+                    str(tmp_path), epoch_interval=1,
+                    max_num_checkpoints=1))
+    with pytest.raises(faults.InjectedFault):
+        t.train(2, None, reader(), ["img", "label"])
+    faults.disarm()
+    assert ckpt.latest_step(str(tmp_path)) == 1
+    assert ckpt.validate_checkpoint(str(tmp_path), 1)

@@ -155,3 +155,49 @@ def test_zoo_export_predictor_parity(tmp_path):
             np.testing.assert_allclose(
                 r, g, rtol=1e-4, atol=1e-5,
                 err_msg=f"zoo model '{name}' predictor mismatch")
+
+
+def test_export_is_staged_and_crash_leaves_no_partial_dir(tmp_path):
+    """Satellite (ISSUE 5): a crash between the export's metadata and
+    parameter writes must not publish a dir load_inference_model starts
+    loading and then dies on — and a crash in the publish-swap window
+    (previous export parked at <dir>.old.tmp) is recovered by the next
+    export."""
+    from paddle_tpu import faults
+    import os
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        probs = layers.softmax(layers.fc(x, 4))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    d = str(tmp_path / "model")
+    try:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            faults.arm("io.export:raise@1")
+            with pytest.raises(faults.InjectedFault):
+                io.save_inference_model(d, ["x"], [probs], exe, main)
+            faults.disarm()
+            assert not os.path.isdir(d)  # torn export stayed staged
+            io.save_inference_model(d, ["x"], [probs], exe, main)
+            # crash in the swap window: dir gone, old export parked —
+            # the LOAD path recovers it (a serving-only host never
+            # exports again)
+            os.rename(d, d + ".old.tmp")
+            with fluid.scope_guard(fluid.Scope()):
+                io.load_inference_model(d, exe)
+            assert os.path.isdir(d) and not os.path.isdir(d + ".old.tmp")
+            os.rename(d, d + ".old.tmp")  # and the save path recovers too
+            io.save_inference_model(d, ["x"], [probs], exe, main)
+        assert not os.path.isdir(d + ".tmp")
+        assert not os.path.isdir(d + ".old.tmp")
+        with fluid.scope_guard(fluid.Scope()):
+            program, feeds, fetches = io.load_inference_model(d, exe)
+            out = exe.run(program,
+                          feed={"x": np.ones((2, 8), np.float32)},
+                          fetch_list=fetches)
+        assert np.asarray(out[0]).shape == (2, 4)
+    finally:
+        faults.disarm()
