@@ -24,7 +24,6 @@ benchmarks/resnet_roofline.md §5).
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp

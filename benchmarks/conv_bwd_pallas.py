@@ -134,7 +134,6 @@ def xla_pair(x, dy, w):
 
 
 def _trace_us(tag, fn, *args, iters=10):
-    import collections
     import glob
     import gzip
     import json

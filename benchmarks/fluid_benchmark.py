@@ -39,7 +39,6 @@ def _synth(shape, dtype="float32", lo=0, hi=None, seed=0):
 def build_model(name, args):
     """-> (feed_fn(step) -> dict, loss_var, examples_per_batch)"""
     import paddle_tpu as fluid
-    from paddle_tpu import layers
 
     b = args.batch_size
     if name == "mnist":

@@ -87,6 +87,16 @@ class CompiledProgram:
         self._strategy = strategy
         self._mesh = strategy.mesh
         self._data_parallel = True
+        # lint-at-build: the sharding + collective-order checks need the
+        # strategy, and this is the first moment program and strategy
+        # meet — a rule mismatch or unplanned reshard surfaces here, not
+        # after the first (minutes-long) compile. Gated on static_lint.
+        from paddle_tpu import analysis
+
+        analysis.lint_at_build(
+            self.program, strategy=strategy,
+            checks=("sharding", "collectives"),
+            site="CompiledProgram.with_strategy")
         return self
 
     @property

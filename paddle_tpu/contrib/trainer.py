@@ -127,6 +127,13 @@ class Trainer:
             from paddle_tpu import passes as _passes
 
             _passes.apply_pass("instrument_numerics", self.main_program)
+        # lint-at-build: verify the fully built train program (forward +
+        # backward + optimizer + instrumentation) before the trainer's
+        # first — and most expensive — compile. Gated on static_lint.
+        from paddle_tpu import analysis as _analysis
+
+        _analysis.lint_at_build(self.main_program, strategy=strategy,
+                                site="contrib.Trainer")
         self.exe = Executor(place)
 
         self._run_program = self.main_program

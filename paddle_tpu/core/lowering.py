@@ -22,10 +22,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-from paddle_tpu.core import autodiff
-from paddle_tpu.core.registry import GRAD_OP_SUFFIX, OpDef, get_op_def, has_op
 from paddle_tpu.framework import Block, Program
 
 # Ops handled by the lowering itself rather than a registered kernel.

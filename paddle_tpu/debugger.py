@@ -93,27 +93,63 @@ def _numerics_lines(program: Program):
     return header, marks
 
 
+def _lint_lines(program: Program):
+    """(header lines, {op idx -> marker}) from the static verifier's
+    latest findings for this program (analysis.findings_for): severity
+    counts plus one line per warning/error, with error sites marked
+    inline on the op listing."""
+    from paddle_tpu import analysis
+
+    rec = analysis.findings_for(program._uid)
+    if rec is None:
+        return [], {}
+    lines = [f"static lint (v{rec.get('v')}, "
+             f"{rec.get('lint_ms', 0.0):.1f}ms): "
+             f"{analysis.format_counts(rec.get('counts') or {})}"]
+    marks = {}
+    for f in rec.get("findings", ()):
+        if f.get("severity") not in ("warning", "error"):
+            continue
+        lines.append(f"  [{f.get('severity')}] {f.get('check')} @ "
+                     f"{f.get('site')}: {f.get('message')}")
+        if f.get("hint"):
+            lines.append(f"    fix: {f['hint']}")
+        if f.get("severity") == "error" and f.get("op_idx") is not None \
+                and f.get("block_idx") == 0:
+            marks.setdefault(
+                f["op_idx"],
+                f"   !! lint: {f.get('check')} ('{f.get('var')}')")
+    return lines, marks
+
+
 def pprint_program(program: Program, with_shapes: bool = True,
                    with_compile_report: bool = True,
                    with_numerics: bool = True,
-                   with_timeline: bool = True) -> str:
+                   with_timeline: bool = True,
+                   with_lint: bool = True) -> str:
     """Readable multi-block listing of a Program's vars and ops,
     prefixed with the latest compile-report annotation when telemetry
     recorded one (``with_compile_report=False`` opts out), the latest
     NaN/Inf provenance record when the numerics plane holds one — the
     offending op line is marked inline (``with_numerics=False`` opts
-    out) — and the latest step's phase breakdown + boundedness verdict
+    out) — the latest step's phase breakdown + boundedness verdict
     from the time-attribution plane (``with_timeline=False`` opts
-    out)."""
+    out), and the static verifier's latest findings for the program
+    with error sites marked inline (``with_lint=False`` opts out)."""
     lines = []
     if with_compile_report:
         lines.extend(_compile_report_lines(program))
     if with_timeline:
         lines.extend(_time_attribution_lines())
     marks = {}
-    if with_numerics:
-        header, marks = _numerics_lines(program)
+    if with_lint:
+        header, marks = _lint_lines(program)
         lines.extend(header)
+    if with_numerics:
+        header, nmarks = _numerics_lines(program)
+        lines.extend(header)
+        for k, v in nmarks.items():
+            marks.setdefault(k, v)
     for block in program.blocks:
         lines.append(f"block {block.idx}:")
         for name, var in sorted(block.vars.items()):

@@ -10,7 +10,7 @@ from __future__ import annotations
 from typing import Optional
 
 from paddle_tpu.dygraph.layers import Layer
-from paddle_tpu.dygraph.tracer import VarBase, get_tracer
+from paddle_tpu.dygraph.tracer import VarBase
 from paddle_tpu.initializer import ConstantInitializer, NormalInitializer
 
 

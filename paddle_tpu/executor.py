@@ -19,12 +19,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu import analysis as _analysis
 from paddle_tpu import monitor as _monitor
 from paddle_tpu import numerics as _numerics
 from paddle_tpu.core import lowering
 from paddle_tpu.framework import (
     CPUPlace,
-    Program,
     TPUPlace,
     Variable,
     default_main_program,
@@ -215,6 +215,18 @@ class Executor:
                 program, compiled, feed_names, run_fetch_names, scope
             )
 
+        if _analysis.lint_active():
+            # static verifier BEFORE the first compile of this signature
+            # (static_lint flag: warn logs findings, error raises; the
+            # off path is the one boolean check above, zero allocations).
+            # Gated on the verifier's OWN fingerprint cache, not this
+            # executor's compile cache: a static_lint mode flip must
+            # re-lint signatures another gate would consider warm.
+            _analysis.lint_before_compile(
+                program, feed_names, run_fetch_names,
+                strategy=compiled._strategy if compiled is not None
+                else None,
+                site="executor.run")
         if (tele and _monitor.memory_budget_bytes() > 0
                 and (not use_program_cache or key not in self._cache)):
             # pre-flight BEFORE paying for the compile: a program whose
@@ -479,6 +491,14 @@ class Executor:
                                                track_nonfinite=nan_track),
                     lowered)
 
+        if _analysis.lint_active():
+            # static verifier before the window's first compile (run()
+            # twin; the whole-window donation/dataflow semantics are the
+            # same single-step block repeated). Gated on the verifier's
+            # own fingerprint cache — see run().
+            _analysis.lint_before_compile(
+                program, feed_names, run_fetch_names,
+                site="executor.run_steps")
         if (tele and _monitor.memory_budget_bytes() > 0
                 and key not in self._cache):
             # per-step feed shapes: drop the stacked window axis

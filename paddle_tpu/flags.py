@@ -103,6 +103,13 @@ _DEFS: Dict[str, tuple] = {
     # derived from (seed, site name), so a seeded chaos run reproduces
     # its fault sequence exactly
     "fault_seed": (int, 0, "seed for probabilistic fault-plan triggers"),
+    # pre-compile static program verifier (analysis.py): 'warn' lints
+    # every program before its first compile and logs warning/error
+    # findings; 'error' additionally raises LintError on error-severity
+    # findings; 'off' disables the verifier entirely (the executor hot
+    # path is then one boolean check, zero allocations)
+    "static_lint": (str, "warn",
+                    "pre-compile static verifier: off|warn|error"),
     # unified retry policy (retry.py) used by fleet connect/kv/heartbeat:
     # first backoff sleep; subsequent sleeps take decorrelated jitter in
     # [base, 3*prev] capped at retry_max_delay_ms

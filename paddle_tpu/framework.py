@@ -17,13 +17,17 @@ OpDescs into blocks of a serializable Program — but:
 
 from __future__ import annotations
 
-import copy
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from paddle_tpu import unique_name
-from paddle_tpu.core.registry import GRAD_SUFFIX, get_op_def, has_op
+from paddle_tpu.core.registry import (
+    GRAD_OP_SUFFIX,
+    GRAD_SUFFIX,
+    get_op_def,
+    has_op,
+)
 from paddle_tpu.proto import framework_pb2 as pb
 
 # Sentinel used to stand in for a symbolic (-1) batch dim during abstract
@@ -374,60 +378,24 @@ class Block:
 
     def _infer_shapes(self, op: Operator):
         """Abstract-eval the kernel to fill output var shapes/dtypes."""
-        if not has_op(op.type):
+        outs, gap = infer_op_outputs(self, op)
+        if outs is None:
+            # Previously a silent no-op: ops with no registered shape
+            # function (or missing input metadata) left their outputs
+            # shapeless with no signal. Record the gap so the static
+            # verifier (analysis.py) can report inference coverage
+            # honestly, and log once per (op_type, kind) at debug level.
+            if gap is not None:
+                _note_infer_gap(op.type, gap)
             return
-        opdef = get_op_def(op.type)
         try:
-            import jax
-
-            ins = {}
-            for slot, names in op.inputs.items():
-                specs = []
-                for n in names:
-                    v = self._find_var_recursive(n)
-                    if v is None or v.shape is None or v.dtype is None:
-                        return  # cannot infer without input metadata
-                    shape = tuple(
-                        _BATCH_SENTINEL if d == -1 else d for d in v.shape
-                    )
-                    specs.append(jax.ShapeDtypeStruct(shape, np.dtype(v.dtype)))
-                ins[slot] = specs
-
-            kwargs = {}
-            if opdef.needs_rng:
-                kwargs["rng"] = jax.random.PRNGKey(0)
-
-            outs = jax.eval_shape(
-                lambda i: opdef.compute(i, dict(op.attrs), **kwargs), ins
-            )
-            for slot, names in op.outputs.items():
-                results = outs.get(slot, [])
-                for n, r in zip(names, results):
-                    if r is None:
-                        continue
-                    v = self._find_var_recursive(n)
-                    if v is None:
-                        v = self.create_var(name=n)
-                    shape = tuple(
-                        -1 if d == _BATCH_SENTINEL else d for d in r.shape
-                    )
-                    v.shape = shape
-                    v.dtype = np.dtype(r.dtype).name
+            apply_inferred_outputs(self, op, outs)
         except Exception as e:
-            # Shape inference is advisory (lowering uses real shapes), but a
-            # silent no-op hides broken kernels/attrs until lowering; log
-            # once per (op_type, error) so build-time breakage is visible.
-            global _SHAPE_INFER_FAILURES
-            sig = (op.type, type(e).__name__)
-            if sig not in _SHAPE_INFER_FAILURES:
-                _SHAPE_INFER_FAILURES.add(sig)
-                import logging
-
-                logging.getLogger("paddle_tpu").warning(
-                    "shape inference failed for op '%s': %s: %s "
-                    "(advisory; real shapes resolved at lowering)",
-                    op.type, type(e).__name__, e,
-                )
+            # a kernel returning a malformed result structure must stay
+            # an advisory gap (real shapes resolve at lowering), not a
+            # build abort
+            _note_infer_gap(op.type,
+                            f"eval_failed:{type(e).__name__}: {e}")
 
     def to_proto(self) -> pb.BlockDesc:
         d = pb.BlockDesc(idx=self.idx, parent_idx=self.parent_idx)
@@ -444,7 +412,103 @@ class Block:
         return "\n".join(lines)
 
 
-_SHAPE_INFER_FAILURES: set = set()
+# (op_type, gap kind) pairs where abstract shape inference could not run
+# — the coverage ledger behind analysis.py's debug-level findings. Kinds:
+# 'no_kernel' (op type has no registered compute), 'missing_input_meta'
+# (an input var lacks shape/dtype), 'eval_failed:<Error>' (the abstract
+# eval itself raised). Bounded by the op-type vocabulary.
+_SHAPE_INFER_GAPS: set = set()
+
+
+def shape_infer_gaps() -> set:
+    """Snapshot of recorded inference-coverage gaps (see above)."""
+    return set(_SHAPE_INFER_GAPS)
+
+
+def _note_infer_gap(op_type: str, gap: str):
+    # ledger + once-per-signature dedup key on the 'eval_failed:<Type>'
+    # prefix; the logged line keeps the full diagnostic message
+    sig = (op_type, gap.split(": ", 1)[0])
+    if sig in _SHAPE_INFER_GAPS:
+        return
+    _SHAPE_INFER_GAPS.add(sig)
+    import logging
+
+    log = logging.getLogger("paddle_tpu")
+    if gap.startswith("eval_failed"):
+        # a raising kernel is build-time breakage worth a warning
+        log.warning(
+            "shape inference failed for op '%s': %s "
+            "(advisory; real shapes resolved at lowering)", op_type, gap)
+    else:
+        log.debug("shape inference skipped for op '%s': %s", op_type, gap)
+
+
+def infer_op_outputs(block: "Block", op: Operator):
+    """Abstract-eval ``op``'s kernel over the block's declared metadata.
+
+    Returns ``(outs, gap)``: ``outs`` maps output slot -> list of
+    ShapeDtypeStructs (``None`` when inference could not run, with
+    ``gap`` naming why — see ``_SHAPE_INFER_GAPS`` kinds). Shared by
+    ``Block._infer_shapes`` (build-time advisory fill) and the static
+    verifier's whole-program shape/dtype re-check (analysis.py), so the
+    two can never disagree about an op's inferred metadata."""
+    if not has_op(op.type):
+        if op.type.endswith(GRAD_OP_SUFFIX) and \
+                has_op(op.type[: -len(GRAD_OP_SUFFIX)]):
+            # derived at lowering by autodiff from the forward kernel;
+            # shapes mirror the differentiated inputs
+            return None, "autodiff_grad"
+        return None, "no_kernel"
+    opdef = get_op_def(op.type)
+    try:
+        import jax
+
+        ins = {}
+        for slot, names in op.inputs.items():
+            specs = []
+            for n in names:
+                v = block._find_var_recursive(n)
+                if v is None or v.shape is None or v.dtype is None:
+                    return None, "missing_input_meta"
+                shape = tuple(
+                    _BATCH_SENTINEL if d == -1 else d for d in v.shape
+                )
+                specs.append(jax.ShapeDtypeStruct(shape, np.dtype(v.dtype)))
+            ins[slot] = specs
+
+        kwargs = {}
+        if opdef.needs_rng:
+            kwargs["rng"] = jax.random.PRNGKey(0)
+
+        outs = jax.eval_shape(
+            lambda i: opdef.compute(i, dict(op.attrs), **kwargs), ins
+        )
+        return outs, None
+    except Exception as e:
+        # the message carries the real diagnostic (broadcast shapes,
+        # bad attr, ...); _note_infer_gap dedups on the prefix only
+        return None, f"eval_failed:{type(e).__name__}: {e}"
+
+
+def apply_inferred_outputs(block: "Block", op: Operator, outs) -> None:
+    """Write ``infer_op_outputs`` results back into the block's var
+    metadata (slot -> list of ShapeDtypeStructs, extra/None entries
+    skipped). Raises on malformed kernel results — callers decide
+    whether that is advisory (``Block._infer_shapes``) or a reportable
+    coverage gap (analysis.py)."""
+    for slot, names in op.outputs.items():
+        results = outs.get(slot, [])
+        for n, r in zip(names, results):
+            if r is None:
+                continue
+            v = block._find_var_recursive(n)
+            if v is None:
+                v = block.create_var(name=n)
+            v.shape = tuple(
+                -1 if d == _BATCH_SENTINEL else d for d in r.shape
+            )
+            v.dtype = np.dtype(r.dtype).name
 
 
 class Program:
@@ -465,6 +529,9 @@ class Program:
         self._amp = False
         # populated by append_backward: {param_name: grad_name}
         self._param_grad_map: Dict[str, str] = {}
+        # version-keyed def-use index cache (analysis.DefUseIndex per
+        # block); every _bump_version invalidates it implicitly
+        self._def_use_cache: Optional[tuple] = None
 
     def _bump_version(self):
         self._version += 1
@@ -495,6 +562,20 @@ class Program:
 
     def all_parameters(self) -> List[Parameter]:
         return [v for b in self.blocks for v in b.all_parameters()]
+
+    def def_use_index(self) -> Dict[int, Any]:
+        """{block idx -> analysis.DefUseIndex} for the whole program,
+        cached on the program and invalidated by any version bump (op
+        append/rewrite). The shared substrate every static-verifier
+        check walks (analysis.py) — and available to passes that want a
+        prebuilt writer/reader map instead of hand-rolling one."""
+        if (self._def_use_cache is None
+                or self._def_use_cache[0] != self._version):
+            from paddle_tpu import analysis
+
+            self._def_use_cache = (
+                self._version, analysis.build_def_use(self))
+        return self._def_use_cache[1]
 
     # --- serialization ---
 

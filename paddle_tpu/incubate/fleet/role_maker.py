@@ -16,7 +16,7 @@ reads:
 from __future__ import annotations
 
 import os
-from typing import Optional, Sequence
+from typing import Optional
 
 
 class RoleMakerBase:

@@ -3,7 +3,7 @@
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Optional
 
 from paddle_tpu import unique_name
 from paddle_tpu.framework import (

@@ -9,7 +9,6 @@ are fixed-capacity tensors plus counts/weights.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
 
 from paddle_tpu.layer_helper import LayerHelper
 from paddle_tpu.layers import nn as _nn

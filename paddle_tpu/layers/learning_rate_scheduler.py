@@ -11,7 +11,6 @@ from __future__ import annotations
 import math
 
 from paddle_tpu import unique_name
-from paddle_tpu.framework import default_main_program, default_startup_program
 from paddle_tpu.layer_helper import LayerHelper
 from paddle_tpu.layers import nn, tensor
 

@@ -3,7 +3,7 @@ layer fns). Each builds vars + appends ops via LayerHelper."""
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Union
+from typing import Optional, Sequence, Union
 
 from paddle_tpu import unique_name
 from paddle_tpu.framework import Variable

@@ -13,7 +13,6 @@ from typing import Dict, Optional
 
 import numpy as np
 
-import paddle_tpu as fluid
 from paddle_tpu import layers
 from paddle_tpu.layers import rnn as rnn_layers
 from paddle_tpu.param_attr import ParamAttr

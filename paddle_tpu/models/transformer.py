@@ -16,7 +16,7 @@ Transformer-base on WMT16 en-de), built TPU-first:
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 import numpy as np
 

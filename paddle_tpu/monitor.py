@@ -1033,6 +1033,8 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
     - ``/compile``  JSON latest compile report per program
     - ``/numerics`` JSON numerics plane: NaN/Inf provenance records +
       latest decoded tensor stats per program (numerics.py)
+    - ``/lint``     JSON static-verifier plane: latest lint record per
+      program (mode, severity counts, findings — analysis.py)
     - ``/trace``    Chrome-trace JSON of the timeline ring (load it in
       Perfetto / chrome://tracing directly)
 
@@ -1079,6 +1081,13 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
                     from paddle_tpu import numerics as _numerics
 
                     body = json.dumps(_numerics.summary(), sort_keys=True,
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif path == "/lint":
+                    # lazy import: analysis.py imports monitor.py
+                    from paddle_tpu import analysis as _analysis
+
+                    body = json.dumps(_analysis.summary(), sort_keys=True,
                                       default=str).encode()
                     ctype = "application/json"
                 elif path == "/trace":

@@ -9,7 +9,6 @@ dist_transformer.py (slice/pad helpers) with static-shape mask tensors
 
 from __future__ import annotations
 
-import functools
 import math
 
 import jax

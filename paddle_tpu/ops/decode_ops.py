@@ -12,7 +12,6 @@ fully batched, and compiled into the XLA While body (no host round trips).
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

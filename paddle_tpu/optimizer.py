@@ -16,7 +16,6 @@ from paddle_tpu.framework import (
     Parameter,
     Variable,
     default_main_program,
-    default_startup_program,
     program_guard,
 )
 from paddle_tpu.layer_helper import LayerHelper

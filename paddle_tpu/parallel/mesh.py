@@ -98,6 +98,15 @@ def create_slice_mesh(
     return create_mesh(axes, devices=devs, set_as_default=set_as_default)
 
 
+def axis_sizes(mesh: Mesh) -> Dict[str, int]:
+    """{axis name -> size} — the static verifier embeds this in every
+    collective-signature entry (analysis.collective_signature) so two
+    ranks that built DIFFERENT meshes diff as a participant-set
+    divergence; per-axis participant counts / reshard-cost denominators
+    use ``axis_size`` (singular, composed-axis aware) above."""
+    return {a: int(mesh.shape[a]) for a in mesh.axis_names}
+
+
 def set_mesh(mesh: Optional[Mesh]):
     global _current_mesh
     _current_mesh = mesh

@@ -21,7 +21,6 @@ Everything (gate, dispatch, expert FFN, combine) lives inside one
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional
 
 import jax

@@ -20,7 +20,6 @@ it) works through the pipeline unchanged.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional
 
 import jax
@@ -203,6 +202,23 @@ def gpipe(
             axis_names=frozenset(manual),
         )(stage_params, x_m, streams_m)
     return out.reshape((b,) + x.shape[1:])
+
+
+def collective_signature(mesh: Mesh, pipe_axis: str = "pipe",
+                         n_micro: Optional[int] = None) -> dict:
+    """Static description of the GPipe schedule's collective footprint
+    over ``mesh``: every rank on ``pipe_axis`` runs the same
+    ``n_micro + n_stages - 1`` ticks, each ending in one ppermute hop
+    (plus the final psum). Consumed by the static verifier's
+    collective-order check (analysis.collective_signature) — extraction
+    only, no tracing."""
+    n_stages = int(mesh.shape[pipe_axis])
+    m = int(n_micro) if n_micro else n_stages
+    return {
+        "participants": n_stages,
+        "schedule": "gpipe",
+        "ticks": m + n_stages - 1,
+    }
 
 
 def sequential_reference(fn, stage_params, x):

@@ -12,13 +12,12 @@ overlaps the ring transfer.
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu import monitor as _monitor
 
@@ -215,6 +214,20 @@ def ring_attention(
     # turns that silent hang into a stall record
     with _monitor.stall_guard("ring_attention.dispatch"):
         return fn(q, k, v, bias)
+
+
+def collective_signature(mesh: Mesh, seq_axis: str = "sp") -> dict:
+    """Static description of the collective a ring-attention trace
+    emits over ``mesh``: every rank on ``seq_axis`` must enter the same
+    ``n`` ppermute rotations in the same order, or the ring deadlocks.
+    Consumed by the static verifier's collective-order check
+    (analysis.collective_signature) — extraction only, no tracing."""
+    n = int(mesh.shape[seq_axis])
+    return {
+        "participants": n,
+        "schedule": "ppermute-ring",
+        "rotations": n,
+    }
 
 
 def reference_attention(q, k, v, causal: bool = False, scale=None):

@@ -20,7 +20,7 @@ passes that build a new Program, e.g. inference pruning).
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Sequence
 
 _PASS_REGISTRY: Dict[str, Callable] = {}
 
@@ -119,6 +119,25 @@ def _instrument_numerics(program, scope=None, vars=None, histogram_bins=0,
     from paddle_tpu import numerics
 
     numerics.instrument(program, vars=vars, histogram_bins=histogram_bins)
+    return program
+
+
+@register_pass("lint")
+def _lint(program, scope=None, feeds=None, fetches=None, strategy=None,
+          checks=None, **kw):
+    """Static program verifier (analysis.py) in pass form: runs every
+    registered check over the shared def-use index, meters + stores the
+    findings (debugger.pprint_program / the /lint route show them), and
+    logs warning/error findings — raising LintError instead when the
+    ``static_lint`` flag is 'error'. The program itself is never
+    mutated; the pass returns it unchanged so lint composes anywhere in
+    a PassManager pipeline."""
+    from paddle_tpu import analysis
+
+    findings = analysis.lint(
+        program, feeds=feeds, fetches=fetches, strategy=strategy,
+        checks=checks, min_severity="debug")
+    analysis._dispatch(findings, site="pass")
     return program
 
 

@@ -9,7 +9,6 @@ the reference's CUPTI DeviceTracer (reference: platform/device_tracer.cc).
 from __future__ import annotations
 
 import contextlib
-import os
 import time
 import warnings
 from typing import Optional

@@ -12,7 +12,7 @@ import queue
 import random as _random
 import threading
 import time
-from typing import Callable, Iterable, List
+from typing import List
 
 from paddle_tpu import monitor as _monitor
 

@@ -15,7 +15,7 @@ zeroed fraction across the masked parameters).
 from __future__ import annotations
 
 import re
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 

@@ -13,7 +13,6 @@ before backend initialization.
 import os
 
 import jax
-import pytest
 
 if os.environ.get("PT_TEST_TPU") == "1":
     # Opt-in real-hardware mode for the TPU-gated kernel tests
@@ -70,18 +69,32 @@ def pytest_configure(config):
         "markers",
         "slow: long-running end-to-end test, excluded from the tier-1 "
         "regression gate (which runs -m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "multidevice_fragile: quarantined TP-sharded 8-device pjit test "
+        "— the environment's glibc heap-corruption crash (reproduces at "
+        "the seed tree; see ROADMAP watch item) aborts the whole pytest "
+        "process on the first such execution. Deselected by default; "
+        "run with PT_TEST_MULTIDEVICE=1 or an explicit -m expression")
 
 
 def pytest_collection_modifyitems(config, items):
-    if config.getoption("--full") or \
-            os.environ.get("PT_TEST_TIER") == "full":
-        return
-    # default smoke tier drops 'full' AND 'slow' (unless the caller's -m
-    # expression names 'slow' explicitly, e.g. `-m slow` to run only the
-    # end-to-end tests)
-    drop = {"full"}
-    if "slow" not in (getattr(config.option, "markexpr", "") or ""):
-        drop.add("slow")
+    markexpr = getattr(config.option, "markexpr", "") or ""
+    # The multidevice_fragile quarantine applies to EVERY tier: the
+    # crash aborts the whole process (no pytest report survives it), so
+    # even --full runs skip these unless explicitly opted in.
+    drop = set()
+    if os.environ.get("PT_TEST_MULTIDEVICE") != "1" and \
+            "multidevice_fragile" not in markexpr:
+        drop.add("multidevice_fragile")
+    if not (config.getoption("--full")
+            or os.environ.get("PT_TEST_TIER") == "full"):
+        # default smoke tier drops 'full' AND 'slow' (unless the
+        # caller's -m expression names 'slow' explicitly, e.g. `-m slow`
+        # to run only the end-to-end tests)
+        drop.add("full")
+        if "slow" not in markexpr:
+            drop.add("slow")
     dropped = [it for it in items if drop & set(it.keywords)]
     if dropped:
         config.hook.pytest_deselected(items=dropped)

@@ -9,7 +9,6 @@ Run: PT_TRAINER_ID=<r> PT_TRAINERS=2 PT_COORD_ENDPOINT=127.0.0.1:<p> \
 
 import json
 import os
-import sys
 
 import jax
 

@@ -1,0 +1,714 @@
+"""Static program verifier tests (ISSUE 6): one positive + one clean
+negative per check family, the static_lint flag plane through
+Executor.run, the seeded cross-rank collective-order case, and the
+zero-alloc contract for the off path (PR 2-5 contract style)."""
+
+import tracemalloc
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis, debugger, flags, layers, monitor, passes
+from paddle_tpu.parallel.mesh import create_mesh
+from paddle_tpu.parallel.strategy import (
+    DistributedStrategy,
+    ShardingRule,
+    transformer_rules,
+)
+
+
+@pytest.fixture(autouse=True)
+def _lint_default():
+    flags.set_flags({"static_lint": "warn", "telemetry": False})
+    yield
+    flags.set_flags({"static_lint": "warn", "telemetry": False})
+
+
+def _clean_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        logits = layers.fc(layers.fc(x, 16, act="relu"), 4)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _mesh():
+    return create_mesh({"data": 2, "model": 4}, set_as_default=False)
+
+
+# --------------------------------------------------------------------------
+# dataflow
+# --------------------------------------------------------------------------
+
+def test_dataflow_clean_training_program_has_no_findings():
+    main, _, loss = _clean_model()
+    assert analysis.lint(main, feeds=["x", "label"],
+                         fetches=[loss.name]) == []
+
+
+def test_dataflow_uninitialized_read_flagged():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        layers.data("a", shape=[4], dtype="float32")
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["ghost"]}, outputs={"Out": ["o"]})
+    f = analysis.lint(prog, feeds=["a"])
+    assert [x.check for x in f] == ["dataflow.uninitialized_read"]
+    assert f[0].severity == "error" and f[0].var == "ghost"
+    assert f[0].hint
+
+
+def test_dataflow_read_before_write_flagged():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        b = prog.global_block()
+        # consumer appended BEFORE its producer
+        b.append_op("relu", inputs={"X": ["late"]}, outputs={"Out": ["o"]})
+        b.append_op("scale", inputs={"X": [x.name]},
+                    outputs={"Out": ["late"]}, attrs={"scale": 2.0})
+    f = analysis.lint(prog, feeds=["x"])
+    assert [x.check for x in f] == ["dataflow.read_before_write"]
+
+
+def test_dataflow_dead_op_and_unreachable_fetch():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        kept = layers.scale(x, scale=2.0)
+        layers.scale(x, scale=3.0)  # dead: never reaches the fetch
+    f = analysis.lint(prog, feeds=["x"], fetches=[kept.name],
+                      min_severity="info")
+    checks = [x.check for x in f]
+    assert "dataflow.dead_op" in checks
+    # info severity: advisory (other run() calls may fetch it)
+    assert all(x.severity == "info" for x in f
+               if x.check == "dataflow.dead_op")
+    f2 = analysis.lint(prog, feeds=["x"], fetches=["nowhere"])
+    assert any(x.check == "dataflow.unreachable_fetch"
+               and x.severity == "error" for x in f2)
+
+
+def test_dataflow_write_never_read_persistable_is_info():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        b = prog.global_block()
+        b.create_var(name="stat", shape=[4], dtype="float32",
+                     persistable=True)
+        b.append_op("scale", inputs={"X": [x.name]},
+                    outputs={"Out": ["stat"]}, attrs={"scale": 1.0})
+    f = analysis.lint(prog, feeds=["x"], min_severity="info")
+    assert [x.check for x in f] == ["dataflow.write_never_read"]
+    assert analysis.lint(prog, feeds=["x"]) == []  # default: warning+
+
+
+# --------------------------------------------------------------------------
+# shapes / dtypes
+# --------------------------------------------------------------------------
+
+def test_shapes_clean_program_negative():
+    main, _, _ = _clean_model()
+    assert not [f for f in analysis.lint(main, min_severity="debug")
+                if f.check.startswith("shapes.")
+                and f.severity != "debug"]
+
+
+def test_shapes_declared_mismatch_flagged():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        h = layers.fc(x, 16)
+    prog.global_block()._find_var_recursive(h.name).shape = (-1, 99)
+    f = analysis.lint(prog)
+    assert any(x.check == "shapes.shape_mismatch" for x in f)
+    msg = next(x for x in f if x.check == "shapes.shape_mismatch")
+    assert "[-1, 99]" in msg.message and "[-1, 16]" in msg.message
+
+
+def test_shapes_dtype_mismatch_and_implicit_downcast():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[8], dtype="float32")
+        h = layers.scale(x, scale=2.0)
+    prog.global_block()._find_var_recursive(h.name).dtype = "float16"
+    checks = {x.check for x in analysis.lint(prog)}
+    assert "shapes.dtype_mismatch" in checks
+    assert "shapes.implicit_downcast" in checks
+    # under an AMP-marked program the downcast audit stands down
+    prog._amp = True
+    prog._bump_version()
+    checks_amp = {x.check for x in analysis.lint(prog)}
+    assert "shapes.implicit_downcast" not in checks_amp
+
+
+def test_shapes_coverage_gap_is_debug_finding():
+    """Satellite: ops with no registered shape function are one
+    debug-level finding instead of a silent fallthrough."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        prog.global_block().append_op(
+            "totally_unregistered_op", inputs={"X": [x.name]},
+            outputs={"Out": ["o"]})
+    f = analysis.lint(prog, min_severity="debug")
+    gaps = [x for x in f if x.check == "shapes.no_inference"]
+    assert len(gaps) == 1 and gaps[0].severity == "debug"
+    assert "no_kernel" in gaps[0].message
+    # default severity filter keeps them out of warn/error reporting
+    assert all(x.check != "shapes.no_inference"
+               for x in analysis.lint(prog))
+    # and the build-time ledger recorded the same gap
+    from paddle_tpu import framework
+    assert ("totally_unregistered_op", "no_kernel") in \
+        framework.shape_infer_gaps()
+
+
+# --------------------------------------------------------------------------
+# donation / aliasing
+# --------------------------------------------------------------------------
+
+def test_donation_clean_optimizer_program_negative():
+    main, _, loss = _clean_model()
+    assert not [f for f in analysis.lint(
+        main, feeds=["x", "label"], fetches=[loss.name],
+        min_severity="debug") if f.check.startswith("donation.")]
+
+
+def _donation_prog():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        b = prog.global_block()
+        b.create_parameter("w", [4], "float32")
+        b.append_op("elementwise_mul", inputs={"X": [x.name], "Y": ["w"]},
+                    outputs={"Out": ["y"]})
+        b.append_op("scale", inputs={"X": ["w"]}, outputs={"Out": ["w"]},
+                    attrs={"scale": 0.9})  # the update (donation point)
+        b.append_op("elementwise_add", inputs={"X": ["y"], "Y": ["w"]},
+                    outputs={"Out": ["z"]})  # post-update re-read
+    return prog
+
+
+def test_donation_read_after_donate_flagged():
+    f = analysis.lint(_donation_prog(), feeds=["x"], fetches=["z"])
+    hits = [x for x in f if x.check == "donation.read_after_donate"]
+    assert len(hits) == 1 and hits[0].var == "w"
+    assert hits[0].severity == "warning"
+
+
+def test_donation_multi_writer_flagged():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        b = prog.global_block()
+        b.create_parameter("w", [4], "float32")
+        b.append_op("elementwise_mul", inputs={"X": [x.name], "Y": ["w"]},
+                    outputs={"Out": ["y"]})
+        for s in (0.9, 0.8):  # two writers alias the donated buffer
+            b.append_op("scale", inputs={"X": ["w"]},
+                        outputs={"Out": ["w"]}, attrs={"scale": s})
+    f = analysis.lint(prog, feeds=["x"], fetches=["y"])
+    assert any(x.check == "donation.multi_writer" and x.var == "w"
+               for x in f)
+
+
+def test_donation_feed_aliasing_state_flagged():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        b = prog.global_block()
+        b.create_parameter("w", [4], "float32")
+        b.append_op("scale", inputs={"X": ["w"]}, outputs={"Out": ["o"]},
+                    attrs={"scale": 1.0})
+    f = analysis.lint(prog, feeds=["w"], fetches=["o"])
+    assert any(x.check == "donation.feed_aliases_state" for x in f)
+
+
+# --------------------------------------------------------------------------
+# sharding / mesh consistency
+# --------------------------------------------------------------------------
+
+def test_sharding_clean_tp_program_negative():
+    mesh = _mesh()
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        x = layers.data("x", shape=[16], dtype="float32")
+        h = layers.fc(x, 32, param_attr=fluid.ParamAttr(name="l1_colp.w"),
+                      bias_attr=fluid.ParamAttr(name="l1_colp.b"),
+                      act="relu")
+        y = layers.fc(h, 16, param_attr=fluid.ParamAttr(name="l2_rowp.w"),
+                      bias_attr=fluid.ParamAttr(name="l2_rowp.b"))
+        loss = layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    st = DistributedStrategy(mesh, rules=transformer_rules())
+    assert not [f for f in analysis.lint(main, feeds=["x"],
+                                         fetches=[loss.name], strategy=st)
+                if f.check.startswith("sharding.")]
+
+
+def test_sharding_direct_conflict_flagged_with_cost():
+    mesh = _mesh()
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        b = prog.global_block()
+        b.create_parameter("wa", [8, 8], "float32")
+        b.create_parameter("wb", [8, 8], "float32")
+        layers.elementwise_add(b.var("wa"), b.var("wb"))
+    st = DistributedStrategy(mesh, rules=[
+        ShardingRule(r"^wa$", P("model", None)),
+        ShardingRule(r"^wb$", P("data", None)),
+    ])
+    f = [x for x in analysis.lint(prog, strategy=st)
+         if x.check == "sharding.unresolvable_mix"]
+    assert len(f) == 1
+    assert f[0].cost_bytes and f[0].cost_bytes > 0
+    assert "model" in f[0].message and "data" in f[0].message
+
+
+def test_sharding_joint_axis_claim_flagged():
+    """No single dim conflicts, but one mesh axis is claimed by two
+    different dims of the union — resolvable only through a reshard."""
+    mesh = _mesh()
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        b = prog.global_block()
+        b.create_parameter("wa", [8, 8], "float32")
+        b.create_parameter("wb", [8, 8], "float32")
+        layers.elementwise_add(b.var("wa"), b.var("wb"))
+    st = DistributedStrategy(mesh, rules=[
+        ShardingRule(r"^wa$", P(None, "model")),
+        ShardingRule(r"^wb$", P("model", None)),
+    ])
+    f = [x for x in analysis.lint(prog, strategy=st)
+         if x.check == "sharding.unresolvable_mix"]
+    assert len(f) == 1 and "axis 'model'" in f[0].message
+
+
+def test_sharding_skipped_entirely_without_strategy():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        b = prog.global_block()
+        b.create_parameter("wa", [8, 8], "float32")
+        b.create_parameter("wb", [8, 8], "float32")
+        layers.elementwise_add(b.var("wa"), b.var("wb"))
+    assert not [x for x in analysis.lint(prog, min_severity="debug")
+                if x.check.startswith("sharding.")]
+
+
+# --------------------------------------------------------------------------
+# collective order
+# --------------------------------------------------------------------------
+
+def _rank_prog(order):
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        q = layers.data("q", shape=[2, 16, 8], dtype="float32")
+        b = prog.global_block()
+        for t in order:
+            if t == "switch_moe":
+                b.append_op("switch_moe", inputs={"X": [q.name]},
+                            outputs={"Out": [f"o_{t}"]})
+            else:
+                b.append_op(t, inputs={"Q": [q.name], "K": [q.name],
+                                       "V": [q.name]},
+                            outputs={"Out": [f"o_{t}"]})
+    return prog
+
+
+def _cp_strategy():
+    mesh = create_mesh({"sp": 4, "expert": 2}, set_as_default=False)
+    return DistributedStrategy(mesh, data_axis=None, context_axis="sp",
+                               expert_axis="expert")
+
+
+def test_collective_order_seeded_cross_rank_mismatch():
+    """Seeded divergence: rank 1 emits the same two collectives in the
+    opposite order — the classic static deadlock."""
+    st = _cp_strategy()
+    a = ["scaled_dot_product_attention", "switch_moe"]
+    progs = [_rank_prog(a), _rank_prog(list(reversed(a)))]
+    f = analysis.check_collective_order(progs, strategy=st)
+    assert len(f) == 1 and f[0].check == "collectives.order_divergence"
+    assert f[0].severity == "error"
+    assert "rank 0" in f[0].message and "rank 1" in f[0].message
+    # count divergence is its own finding
+    f2 = analysis.check_collective_order(
+        [_rank_prog(a), _rank_prog(a[:1])], strategy=st)
+    assert f2[0].check == "collectives.count_divergence"
+
+
+def test_collective_order_identical_ranks_negative():
+    st = _cp_strategy()
+    a = ["scaled_dot_product_attention", "switch_moe"]
+    assert analysis.check_collective_order(
+        [_rank_prog(a), _rank_prog(a), _rank_prog(a)], strategy=st) == []
+
+
+def test_collective_signature_extracts_participants():
+    st = _cp_strategy()
+    sig = analysis.collective_signature(
+        _rank_prog(["scaled_dot_product_attention", "switch_moe"]), st)
+    assert [e["kind"] for e in sig] == ["ring_attention", "all_to_all"]
+    assert sig[0]["participants"] == 4  # sp axis size
+    assert sig[0]["schedule"] == "ppermute-ring"
+    assert sig[1]["participants"] == 2  # expert axis size
+
+
+def test_collective_under_cond_flagged_single_program():
+    st = _cp_strategy()
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[2, 16, 8], dtype="float32")
+        b = prog.global_block()
+        sub = prog._create_block()
+        prog._rollback()
+        sub.append_op("switch_moe", inputs={"X": [x.name]},
+                      outputs={"Out": ["moe_o"]})
+        b.append_op("cond", inputs={"Cond": [x.name]},
+                    outputs={"Out": ["c_o"]},
+                    attrs={"true_block": sub, "false_block": sub,
+                           "true_out_names": ["moe_o"],
+                           "false_out_names": ["moe_o"]})
+    f = [x for x in analysis.lint(prog, strategy=st)
+         if x.check == "collectives.control_flow"]
+    assert len(f) == 1 and "switch_moe" in f[0].message
+    # without a strategy the sdpa/moe ops are dense kernels: no findings
+    assert not [x for x in analysis.lint(prog, min_severity="debug")
+                if x.check.startswith("collectives.")]
+
+
+# --------------------------------------------------------------------------
+# flag plane through Executor.run + pass form + annotations
+# --------------------------------------------------------------------------
+
+def test_static_lint_error_raises_through_executor_run():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["ghost"]}, outputs={"Out": ["o"]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    flags.set_flags({"static_lint": "error"})
+    with fluid.scope_guard(scope):
+        with pytest.raises(analysis.LintError) as ei:
+            exe.run(prog, feed={"x": np.ones((1, 4), np.float32)},
+                    fetch_list=["o"])
+    assert any(f.check == "dataflow.uninitialized_read"
+               for f in ei.value.findings)
+    # warn mode: same program logs but reaches the (failing) compile
+    flags.set_flags({"static_lint": "warn"})
+    with fluid.scope_guard(scope):
+        with pytest.raises(Exception) as ei2:
+            exe.run(prog, feed={"x": np.ones((1, 4), np.float32)},
+                    fetch_list=["o"])
+    assert not isinstance(ei2.value, analysis.LintError)
+
+
+def test_static_lint_error_raises_again_on_retry():
+    """The pre-compile fingerprint cache must not swallow the error
+    gate: a retried run of the same broken program re-lints and
+    re-raises instead of proceeding to the compiler."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["ghost"]}, outputs={"Out": ["o"]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    flags.set_flags({"static_lint": "error"})
+    for _ in range(2):  # second call must NOT hit a poisoned cache
+        with pytest.raises(analysis.LintError):
+            exe.run(prog, feed={}, fetch_list=["o"])
+
+
+def test_collective_order_mesh_mismatch_diverges():
+    """Two ranks that built different meshes diverge even when the op
+    sequence matches — the mesh shape rides the signature."""
+    a = ["scaled_dot_product_attention"]
+    st4 = _cp_strategy()
+    mesh2 = create_mesh({"sp": 2, "expert": 4}, set_as_default=False)
+    st2 = DistributedStrategy(mesh2, data_axis=None, context_axis="sp",
+                              expert_axis="expert")
+    f = analysis.check_collective_order(
+        [_rank_prog(a), _rank_prog(a)], strategy=[st4, st2])
+    assert len(f) == 1 and f[0].check == "collectives.order_divergence"
+
+
+def test_mode_flip_warn_to_error_relints_cached_signature():
+    """Fingerprints linted under warn must re-lint after a flip to
+    error: the mode change clears the pre-compile cache."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["ghost"]}, outputs={"Out": ["o"]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    flags.set_flags({"static_lint": "warn"})
+    with pytest.raises(Exception) as ei:  # lint logs, lowering raises
+        exe.run(prog, feed={}, fetch_list=["o"])
+    assert not isinstance(ei.value, analysis.LintError)
+    flags.set_flags({"static_lint": "error"})
+    with pytest.raises(analysis.LintError):
+        exe.run(prog, feed={}, fetch_list=["o"])
+
+
+def test_collective_order_pipe_micro_mismatch_diverges():
+    """Same mesh, same op order, different pipe_micro: the GPipe
+    schedules have different hop counts — a deadlock the ticks field
+    must catch."""
+    mesh = create_mesh({"pipe": 4, "data": 2}, set_as_default=False)
+
+    def prog():
+        p = fluid.Program()
+        with fluid.program_guard(p, fluid.Program()):
+            x = layers.data("x", shape=[8], dtype="float32")
+            sub = p._create_block()
+            p._rollback()
+            p.global_block().append_op(
+                "scan", inputs={"X": [x.name]}, outputs={"Y": ["y"]},
+                attrs={"pipelinable": True, "sub_block": sub,
+                       "x_names": ["xt"], "state_in": [],
+                       "state_out": [], "y_names": ["yt"]})
+        return p
+
+    def st(micro):
+        return DistributedStrategy(mesh, pipe_axis="pipe",
+                                   pipe_micro=micro)
+
+    f = analysis.check_collective_order([prog(), prog()],
+                                        strategy=[st(4), st(8)])
+    assert len(f) == 1 and f[0].check == "collectives.order_divergence"
+    assert analysis.check_collective_order(
+        [prog(), prog()], strategy=[st(4), st(4)]) == []
+    with pytest.raises(ValueError):  # strategy list length mismatch
+        analysis.check_collective_order([prog(), prog()],
+                                        strategy=[st(4)])
+
+
+def test_standalone_fetch_of_declared_input_not_flagged():
+    """fetches= without feeds= must apply the same declared-input
+    heuristic as the uninitialized-read check."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.scale(x, scale=2.0)
+    assert analysis.lint(prog, fetches=["x", y.name]) == []
+
+
+def test_malformed_kernel_result_is_gap_not_abort():
+    """A registered op whose compute returns a non-dict must stay an
+    advisory coverage gap at build AND lint time, not an abort."""
+    from paddle_tpu import framework
+    from paddle_tpu.core.registry import _OP_REGISTRY, register_op
+
+    name = "lint_test_malformed_op"
+    if name not in _OP_REGISTRY:
+        @register_op(name, no_grad=True)
+        def _malformed(ins, attrs):
+            return [x * 2 for xs in ins.values() for x in xs]  # not a dict
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        prog.global_block().append_op(  # build must not raise
+            name, inputs={"X": [x.name]}, outputs={"Out": ["o"]})
+    assert any(t == name for t, _ in framework.shape_infer_gaps())
+    f = analysis.lint(prog, feeds=["x"], min_severity="debug")
+    assert any(x.check == "shapes.no_inference" and x.op_type == name
+               for x in f)
+    assert analysis.lint(prog, feeds=["x"]) == []
+
+
+def test_strategy_fingerprint_is_content_keyed():
+    """The pre-compile cache keys strategies by CONTENT, not id():
+    a different strategy for the same program re-lints (id reuse after
+    GC must not alias it), while an equal-content new object doesn't."""
+    mesh = _mesh()
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        b = prog.global_block()
+        b.create_parameter("wa", [8, 8], "float32")
+        b.create_parameter("wb", [8, 8], "float32")
+        layers.elementwise_add(b.var("wa"), b.var("wb"))
+
+    def strat(axis):
+        return DistributedStrategy(mesh, rules=[
+            ShardingRule(r"^wa$", P(axis, None)),
+            ShardingRule(r"^wb$", P("data", None))])
+
+    flags.set_flags({"telemetry": True})  # counters need the plane on
+
+    def runs():
+        return monitor.counter("pt_lint_runs_total").value()
+
+    r0 = runs()
+    analysis.lint_at_build(prog, strategy=strat("model"), site="t-fp")
+    assert runs() == r0 + 1
+    analysis.lint_at_build(prog, strategy=strat("model"), site="t-fp")
+    assert runs() == r0 + 1  # equal content: cached
+    analysis.lint_at_build(prog, strategy=strat("data"), site="t-fp")
+    assert runs() == r0 + 2  # different content: re-lints
+
+
+def test_infer_gap_keeps_diagnostic_message():
+    """eval_shape failures keep the kernel's actual error message in
+    the lint finding (the ledger dedups on the type prefix only)."""
+    from paddle_tpu import framework
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.data("y", shape=[5], dtype="float32")
+        prog.global_block().append_op(  # 4 vs 5: broadcast error
+            "elementwise_add", inputs={"X": [x.name], "Y": [y.name]},
+            outputs={"Out": ["o"]}, attrs={"axis": -1})
+    f = [g for g in analysis.lint(prog, min_severity="debug")
+         if g.check == "shapes.no_inference"]
+    assert f and "eval_failed:" in f[0].message
+    assert any(len(m) > len("eval_failed:TypeError")
+               for m in [f[0].message])  # a real diagnostic rode along
+    assert any(t == "elementwise_add" and g.startswith("eval_failed:")
+               for t, g in framework.shape_infer_gaps())
+
+
+def test_lint_pass_registered_and_composes():
+    main, _, _ = _clean_model()
+    assert "lint" in passes.registered_passes()
+    out = passes.apply_pass("lint", main)
+    assert out is main
+    rec = analysis.findings_for(main._uid)
+    assert rec is not None and rec["program"] == f"program{main._uid}"
+
+
+def test_lint_report_and_debugger_annotation():
+    prog = _donation_prog()
+    rep = analysis.lint_report(prog, feeds=["x"], fetches=["z"])
+    assert rep.startswith("static lint (")
+    assert "donation.read_after_donate" in rep
+    listing = debugger.pprint_program(prog)
+    assert "static lint (v1" in listing
+    assert "donation.read_after_donate" in listing
+    # opting out drops the header
+    assert "static lint" not in debugger.pprint_program(
+        prog, with_lint=False)
+
+
+def test_findings_metered():
+    flags.set_flags({"telemetry": True})
+    c0 = monitor.counter("pt_lint_findings_total").value(
+        labels={"check": "dataflow", "severity": "error"})
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["ghost"]}, outputs={"Out": ["o"]})
+    analysis.lint(prog)
+    c1 = monitor.counter("pt_lint_findings_total").value(
+        labels={"check": "dataflow", "severity": "error"})
+    assert c1 == c0 + 1
+    assert monitor.counter("pt_lint_runs_total").value() > 0
+
+
+def test_def_use_index_cached_per_version():
+    main, _, _ = _clean_model()
+    i1 = main.def_use_index()
+    assert i1 is main.def_use_index()  # same version -> cached
+    main.global_block().append_op(
+        "scale", inputs={"X": ["x"]}, outputs={"Out": ["x2"]},
+        attrs={"scale": 1.0})
+    assert main.def_use_index() is not i1  # version bump invalidates
+
+
+def test_executor_lint_runs_once_per_signature():
+    main, startup, loss = _clean_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"x": np.ones((2, 8), np.float32),
+            "label": np.zeros((2, 1), np.int64)}
+    runs0 = monitor.counter("pt_lint_runs_total").value()
+    flags.set_flags({"telemetry": True})
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss])
+    runs1 = monitor.counter("pt_lint_runs_total").value()
+    # one lint for the startup signature + one for the train signature
+    assert runs1 - runs0 <= 2
+
+
+# --------------------------------------------------------------------------
+# zoo cleanliness + perf budget
+# --------------------------------------------------------------------------
+
+def test_zoo_models_lint_clean_under_defaults():
+    from paddle_tpu.models import mnist as mnist_model
+
+    for build in (lambda: mnist_model.get_model(use_conv=False),
+                  lambda: mnist_model.get_model(use_conv=True)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            model = build()
+            fluid.optimizer.Adam(1e-3).minimize(model["loss"])
+        assert analysis.lint(main) == []
+        assert analysis.lint(startup) == []
+
+
+def test_bench_transformer_lints_clean_and_fast():
+    """Acceptance: zero findings on the bench transformer under
+    defaults, lint completes < 250 ms at steady state (def-use and
+    eval-shape memos warm, the executor-path regime)."""
+    import time
+
+    from paddle_tpu.models import transformer as T
+
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        model = T.build(T.TransformerConfig())
+        fluid.optimizer.Adam(1e-3).minimize(model["loss"])
+    assert analysis.lint(main) == []  # cold: correctness
+    t0 = time.perf_counter()
+    assert analysis.lint(main) == []
+    ms = (time.perf_counter() - t0) * 1e3
+    assert ms < 250, f"steady-state lint took {ms:.0f}ms"
+
+
+# --------------------------------------------------------------------------
+# zero-alloc contract: static_lint=off on the executor hot path
+# --------------------------------------------------------------------------
+
+def test_static_lint_off_allocates_nothing_in_analysis():
+    flags.set_flags({"static_lint": "off"})
+    assert not analysis.lint_active()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.fc(x, 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"x": np.ones((2, 4), np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):  # warm compile cache + lazy state
+            exe.run(main, feed=feed, fetch_list=[y])
+        n_runs = 30
+        tracemalloc.start()
+        base = tracemalloc.take_snapshot()
+        for _ in range(n_runs):
+            exe.run(main, feed=feed, fetch_list=[y])
+        snap = tracemalloc.take_snapshot()
+        tracemalloc.stop()
+    stats = snap.compare_to(base, "filename")
+    grew = sum(s.size_diff for s in stats
+               if s.traceback[0].filename.endswith("analysis.py")
+               and s.size_diff > 0)
+    assert grew < n_runs * 16, (
+        f"static_lint=off Executor.run allocated {grew}B in analysis.py "
+        f"over {n_runs} runs")
+
+
+def test_invalid_mode_degrades_to_warn():
+    flags.set_flags({"static_lint": "bogus"})
+    assert analysis.lint_mode() == "warn"

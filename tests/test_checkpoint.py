@@ -68,6 +68,7 @@ def _batches(n, seed=0):
     return out
 
 
+@pytest.mark.multidevice_fragile
 def test_tp_sharded_roundtrip_bit_exact_resume(tmp_path):
     main, startup, loss = _build()
     scope = fluid.Scope()
@@ -99,6 +100,7 @@ def test_tp_sharded_roundtrip_bit_exact_resume(tmp_path):
     np.testing.assert_array_equal(ref[4:], resumed)  # bit-exact
 
 
+@pytest.mark.multidevice_fragile
 def test_sharded_values_roundtrip_exactly(tmp_path):
     """The reassembled full array must equal the original global value."""
     main, startup, loss = _build()
@@ -211,6 +213,7 @@ def test_committed_dir_has_marker_and_no_staging_left(tmp_path):
     assert ckpt.latest_step(str(tmp_path)) == 2
 
 
+@pytest.mark.multidevice_fragile
 def test_crash_mid_shard_write_falls_back_bit_identical(tmp_path):
     """Kill-mid-write via injected fault: the Nth checkpoint's shard
     write crashes -> resume restores checkpoint N-1 bit-identically and
@@ -383,6 +386,7 @@ def test_displaced_serial_recovered_after_resave_crash(tmp_path):
     assert ckpt.load_latest(str(tmp_path))[0] == 2
 
 
+@pytest.mark.multidevice_fragile
 def test_resave_same_serial_replaces_it(tmp_path):
     main, startup, loss = _build()
     scope = fluid.Scope()

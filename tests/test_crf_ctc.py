@@ -5,7 +5,6 @@ test_warpctc_op.py compare to python reimplementations)."""
 import itertools
 
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers

@@ -14,7 +14,6 @@ import numpy as np
 import pytest
 
 import paddle_tpu as fluid
-from paddle_tpu import layers
 from paddle_tpu.models import transformer
 
 BOS, EOS = 0, 1

@@ -18,7 +18,6 @@ import numpy as np
 import pytest
 
 import paddle_tpu as fluid
-from paddle_tpu import layers
 from paddle_tpu.incubate.fleet import UserDefinedRoleMaker, fleet as _fleet
 
 HERE = os.path.dirname(os.path.abspath(__file__))

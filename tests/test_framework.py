@@ -1,6 +1,5 @@
 """Program IR construction, shape inference, serialization round-trip."""
 
-import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu import layers

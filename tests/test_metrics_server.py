@@ -70,6 +70,26 @@ def test_healthz_and_404():
     assert ei.value.code == 404
 
 
+def test_lint_endpoint_serves_latest_findings():
+    from paddle_tpu import analysis
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        prog.global_block().append_op(
+            "relu", inputs={"X": ["ghost"]}, outputs={"Out": ["o"]})
+    analysis.lint(prog)
+    monitor.enable()
+    port = monitor.serve(0)
+    status, ctype, body = _get(port, "/lint")
+    assert status == 200 and ctype == "application/json"
+    doc = json.loads(body)
+    assert doc["mode"] in ("off", "warn", "error")
+    rec = doc["reports"][str(prog._uid)]
+    assert rec["counts"].get("error", 0) >= 1
+    assert any(f["check"] == "dataflow.uninitialized_read"
+               for f in rec["findings"])
+
+
 def test_trace_endpoint_serves_live_timeline():
     """A running server alone makes tracing visible (no trace_dir
     needed): /trace returns loadable Chrome-trace JSON of the ring."""

@@ -2,7 +2,6 @@
 (reference test strategy: SURVEY.md section 4 item 1)."""
 
 import numpy as np
-import pytest
 
 from op_test import OpHarness
 

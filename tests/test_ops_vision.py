@@ -4,7 +4,6 @@ numpy reference, differentiable ones through the numeric-grad harness
 (reference test strategy: unittests/op_test.py)."""
 
 import numpy as np
-import pytest
 
 from op_test import OpHarness
 

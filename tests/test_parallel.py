@@ -10,7 +10,6 @@ import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu import layers
-from paddle_tpu.data_feeder import DataFeeder
 
 
 def _build(optimizer):

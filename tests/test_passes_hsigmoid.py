@@ -20,7 +20,6 @@ def test_pass_registry_and_manager():
 def test_conv_bn_fuse_pass_matches_transpiler(tmp_path):
     """The registered pass produces the same program rewrite the
     transpiler API does (same op-type counts)."""
-    import copy
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -67,7 +66,6 @@ def test_hsigmoid_trains():
 def test_hsigmoid_matches_manual_power_of_two():
     """C=8: every label has a 3-node path; compare against the explicit
     per-node logistic losses."""
-    import jax
 
     vocab, d, b = 8, 4, 5
     r = np.random.RandomState(1)

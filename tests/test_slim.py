@@ -3,7 +3,6 @@
 slim/distillation/distiller.py)."""
 
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import layers, slim
