@@ -40,7 +40,8 @@ _M_LOSS = _monitor.gauge(
 _M_RESUMES = _monitor.counter(
     "pt_trainer_auto_resumes_total",
     "training failures auto-recovered by restoring the last valid "
-    "checkpoint (CheckpointConfig.max_resume_retries)")
+    "checkpoint (CheckpointConfig.max_resume_retries), by whether the "
+    "world size changed since the save (resized)")
 
 # chaos hook: armed plans can fail the Nth batch fetch, driving the
 # auto-resume loop deterministically (tests/test_faults.py)
@@ -48,6 +49,24 @@ _F_READER_NEXT = _faults.site("reader.next")
 
 
 _RNG_STEP_KEY = "__trainer_rng_step__"
+_WORLD_KEY = "__trainer_world__"
+
+
+def _current_world() -> int:
+    """Data-parallel world size of THIS run: the fleet's worker count
+    when the fleet is up, else the jax process count. Saved into every
+    checkpoint so a resume onto a resized world can re-derive its
+    cursors (shard boundaries move when the world shrinks/grows)."""
+    try:
+        from paddle_tpu.incubate.fleet import fleet as _fleet
+
+        if _fleet._initialized:
+            return _fleet.worker_num()
+    except Exception:  # pragma: no cover — fleet plane absent
+        pass
+    import jax
+
+    return jax.process_count()
 
 
 class BeginEpochEvent:
@@ -81,7 +100,16 @@ class CheckpointConfig:
     ``max_resume_retries``: on a training failure (a raising step,
     reader, or event handler), ``Trainer.train`` restores the newest
     VALID checkpoint and continues from its epoch, at most this many
-    times per ``train()`` call. 0 (default) = fail fast."""
+    times per ``train()`` call. 0 (default) = fail fast.
+
+    ``async_save``: overlap checkpoint serialization + commit with the
+    next epoch's training steps (parallel/checkpoint.py _AsyncHandle
+    seam — the device->host snapshot still happens synchronously, so
+    the training step may freely donate the buffers afterwards). The
+    previous save is waited on before the next one starts and at the
+    end of ``train()``, so a failed background save surfaces within one
+    checkpoint interval and the auto-resume loop sees it like any other
+    training failure."""
 
     def __init__(
         self,
@@ -89,11 +117,13 @@ class CheckpointConfig:
         epoch_interval: int = 1,
         max_num_checkpoints: int = 3,
         max_resume_retries: int = 0,
+        async_save: bool = False,
     ):
         self.checkpoint_dir = checkpoint_dir
         self.epoch_interval = max(1, int(epoch_interval))
         self.max_num_checkpoints = max(1, int(max_num_checkpoints))
         self.max_resume_retries = max(0, int(max_resume_retries))
+        self.async_save = bool(async_save)
 
 
 class Trainer:
@@ -149,6 +179,8 @@ class Trainer:
 
         self._stopped = False
         self._start_epoch = 0
+        self._pending_save = None  # (serial, _AsyncHandle) in flight
+        self._last_resume_resized = False
         with scope_guard(self.scope):
             self.exe.run(self.startup_program)
             self._maybe_resume()
@@ -186,21 +218,97 @@ class Trainer:
                 f"from the run that saved it"
             )
         # restore the executor RNG cursor so stochastic ops (dropout)
-        # replay identically to the uninterrupted run
+        # replay identically to the uninterrupted run. After an elastic
+        # RESIZE the cursor is re-derived for the new world: the cursor
+        # counts per-process steps, so the same GLOBAL data position is
+        # old_steps * old_world / new_world steps into the new world
+        # (data-parallel shard boundaries move with the world size; the
+        # epoch position itself is world-independent — checkpoints are
+        # epoch-granular and every world runs the same global batches).
         rng_step = self.scope.find_var(_RNG_STEP_KEY)
+        saved_world = self.scope.find_var(_WORLD_KEY)
+        world = _current_world()
+        resized = (saved_world is not None
+                   and int(np.asarray(saved_world)) != world)
         if rng_step is not None:
-            self.exe._step = int(np.asarray(rng_step))
+            cursor = int(np.asarray(rng_step))
+            if resized:
+                cursor = (cursor * int(np.asarray(saved_world))) // world
+            self.exe._step = cursor
             self.scope.drop(_RNG_STEP_KEY)
+        if saved_world is not None:
+            self.scope.drop(_WORLD_KEY)
+        self._last_resume_resized = resized
+        if resized:
+            _M_RESUMES.inc(labels={"resized": "true"})
+            warnings.warn(
+                f"resumed checkpoint_{step} saved by a "
+                f"{int(np.asarray(saved_world))}-worker world onto "
+                f"{world} workers; RNG cursor re-derived to "
+                f"{self.exe._step}", RuntimeWarning)
         self._start_epoch = step  # serial number = next epoch to run
         return step
 
+    def _wait_pending_save(self):
+        """Land the in-flight overlapped save, if any: surfaces its
+        error into the train loop (-> auto-resume budget) and runs the
+        pruning deferred until its commit."""
+        pending = self._pending_save
+        if pending is None:
+            return
+        self._pending_save = None
+        serial, handle = pending
+        handle.wait()
+        self._prune(serial)
+
+    def _settle_pending_save(self):
+        """Land an in-flight overlapped save before a RESUME decision,
+        without burning a second resume retry on its failure. Waiting
+        first matters twice over: a commit that lands makes its serial
+        the restore point (no wasted replay from N-1), and the restore's
+        directory scan must not race the background thread's rename/
+        staging sweep. A pending-save failure is warned, not raised —
+        one fault, one retry (the training failure that brought us
+        here); resume proceeds from the newest valid serial."""
+        pending = self._pending_save
+        if pending is None:
+            return
+        self._pending_save = None
+        serial, handle = pending
+        try:
+            handle.wait()
+        except Exception as e:  # noqa: BLE001 — subsumed by the resume
+            warnings.warn(
+                f"overlapped save of checkpoint_{serial} failed during "
+                f"auto-resume ({type(e).__name__}: {e}); resuming from "
+                f"the newest valid serial", RuntimeWarning)
+            return
+        self._prune(serial)
+
     def _save_checkpoint(self, serial: int):
         cfg = self._ckpt_cfg
+        self._wait_pending_save()
         self.scope.set(_RNG_STEP_KEY, np.int64(self.exe._step))
+        self.scope.set(_WORLD_KEY, np.int64(_current_world()))
         try:
-            _ckpt.save_scope(cfg.checkpoint_dir, self.scope, step=serial)
+            handle = _ckpt.save_scope(cfg.checkpoint_dir, self.scope,
+                                      step=serial,
+                                      async_save=cfg.async_save)
         finally:
+            # safe even under async_save: the device->host snapshot is
+            # materialized before save_scope returns, so the scope keys
+            # may be dropped (and buffers donated) immediately
             self.scope.drop(_RNG_STEP_KEY)
+            self.scope.drop(_WORLD_KEY)
+        if handle is not None:
+            # overlapped save: checksum + serialize + commit run while
+            # the next epoch trains; pruning waits for the commit
+            self._pending_save = (serial, handle)
+            return
+        self._prune(serial)
+
+    def _prune(self, serial: int):
+        cfg = self._ckpt_cfg
         # Prune old serial dirs beyond max_num_checkpoints — only AFTER
         # the new checkpoint committed (a failed save raises above and
         # skips pruning), and never the last resumable state: the keep
@@ -266,13 +374,23 @@ class Trainer:
                     num_epochs, event_handler, reader, feed_order,
                     log_time_attribution)
             except (KeyboardInterrupt, SystemExit):
+                # deliberately NOT settled: an interrupt should not block
+                # on a background commit; the staging protocol already
+                # guarantees valid-or-absent serials if the daemon thread
+                # dies mid-commit with the process
                 raise
             except Exception as e:  # noqa: BLE001 — auto-resume budget
                 if retries <= 0:
+                    # land the overlapped save before handing control to
+                    # caller-side recovery: its directory scan must not
+                    # race the background rename, and its error must not
+                    # vanish into an atexit warning
+                    self._settle_pending_save()
                     raise
                 retries -= 1
                 self._start_epoch = 0
                 self._stopped = False
+                self._settle_pending_save()
                 with scope_guard(self.scope):
                     step = self._maybe_resume()
                 if step is None:
@@ -281,7 +399,10 @@ class Trainer:
                     f"training failed ({type(e).__name__}: {e}); "
                     f"auto-resuming from checkpoint_{step} "
                     f"({retries} retries left)", RuntimeWarning)
-                _M_RESUMES.inc()
+                if not self._last_resume_resized:
+                    # a resized resume already counted itself into the
+                    # resized="true" cell in _maybe_resume
+                    _M_RESUMES.inc(labels={"resized": "false"})
 
     def _train_impl(
         self,
@@ -355,6 +476,10 @@ class Trainer:
                     with _monitor.span("trainer.checkpoint"):
                         self._save_checkpoint(epoch + 1)
                     _M_CKPTS.inc()
+            # train() returns only with every overlapped save durable —
+            # a background failure surfaces HERE, inside the auto-resume
+            # budget, not as a warning after the fact
+            self._wait_pending_save()
 
     def test(self, reader, feed_order: Sequence[str]):
         feeder = DataFeeder(

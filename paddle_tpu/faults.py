@@ -138,6 +138,32 @@ class Site:
                 raise InjectedFault(self.name, hit, str(r.arg or ""))
 
 
+# The production sites, for plan authors (each is created by its
+# declaring module's import; `import paddle_tpu` pulls in all of them).
+# Keep in sync with the declarations — tests/test_faults.py proves every
+# name here resolves to a registered site.
+BUILTIN_SITES = {
+    "ckpt.write_shards": "checkpoint shard .npz written, pre-commit "
+                         "(parallel/checkpoint.py; truncate = torn shard)",
+    "ckpt.commit": "checkpoint COMMIT-marker write on process 0 "
+                   "(parallel/checkpoint.py; delay = slow commit, "
+                   "proving async-save overlap)",
+    "ckpt.read": "restore path: each manifest parse AND each shard-file "
+                 "read (parallel/checkpoint.py _read_raw; raise/truncate "
+                 "= torn restore, validation treats the serial invalid)",
+    "fleet.connect": "coord-server connect attempt (fleet_base)",
+    "fleet.kv_get": "coord KV get attempt (fleet_base; also the "
+                    "commit-barrier ack/publish waits)",
+    "fleet.kv_put": "coord KV put attempt (fleet_base; also the "
+                    "commit-barrier acks)",
+    "fleet.heartbeat": "worker heartbeat RPC (fleet_base)",
+    "fleet.resize": "elastic-resize planning after dead-worker "
+                    "detection (fleet_base.plan_resize)",
+    "reader.next": "trainer batch fetch (contrib/trainer.py)",
+    "io.export": "inference-model export publish (io.py)",
+}
+
+
 def site(name: str) -> Site:
     """Get-or-create the named site (module-level singleton)."""
     with _LOCK:

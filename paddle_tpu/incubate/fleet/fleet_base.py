@@ -23,7 +23,9 @@ data parallelism; per-step liveness goes through heartbeat/dead_workers
 from __future__ import annotations
 
 import atexit
+import os as _os
 import re as _re
+import sys as _sys
 import time as _time
 from typing import Optional, Sequence
 
@@ -49,6 +51,10 @@ _M_RENDEZVOUS = _monitor.counter(
 _M_DEAD_EVENTS = _monitor.counter(
     "pt_fleet_dead_worker_events_total",
     "barrier_or_dead returns that reported dead peers")
+_M_RESIZES = _monitor.counter(
+    "pt_fleet_resizes_total",
+    "elastic world resizes: re-rendezvous of a shrunk world launched "
+    "after dead-worker detection")
 
 # chaos hooks: armed plans fail/delay the Nth coordination RPC, so the
 # retry policy's behavior is reproducibly testable (faults.py docstring)
@@ -56,6 +62,7 @@ _F_CONNECT = _faults.site("fleet.connect")
 _F_KV_GET = _faults.site("fleet.kv_get")
 _F_KV_PUT = _faults.site("fleet.kv_put")
 _F_HEARTBEAT = _faults.site("fleet.heartbeat")
+_F_RESIZE = _faults.site("fleet.resize")
 
 # heartbeats are fired from poll loops — a few quick retries beat a long
 # backoff that would itself age the heartbeat past max_age_ms
@@ -313,6 +320,142 @@ class Fleet:
                         f"neither arrived nor declared dead within "
                         f"{timeout_ms} ms")
                 _time.sleep(poll_ms / 1000.0)
+
+    # --- elastic resize (SURVEY.md section 5 recovery loop) ---
+
+    def generation(self) -> int:
+        """How many times this process's lineage re-rendezvoused (0 =
+        the original world; ``reexec_resized`` bumps it via PT_GEN)."""
+        return int(_os.environ.get("PT_GEN", "0"))
+
+    def settle_dead(self, observed: Sequence = (),
+                    max_age_ms: int = 5_000, poll_ms: int = 100,
+                    timeout_ms: Optional[int] = None) -> Sequence[str]:
+        """One AGREED dead set for every survivor. The liveness signal
+        is not atomic: peers of the same crash cross the staleness
+        threshold at different poll instants, so two survivors can
+        return from ``barrier_or_dead`` with DIFFERENT partial dead sets
+        — and would then derive different shrunk worlds and hang each
+        other's recovery rendezvous. Each survivor keeps polling (and
+        heartbeating, so survivors never mutually expire) until its
+        accumulated dead set has been stable for one full staleness
+        window; then the lowest-ranked survivor publishes its settled
+        set over the KV (generation-keyed, so a later resize gets fresh
+        keys) and every other survivor adopts the published set, acking
+        the read so the leader never tears its coord server down under
+        a peer still fetching. Assumes declared-dead workers stay dead
+        (there is no mid-sequence rejoin; a falsely-stale-but-alive
+        worker is excluded like a dead one and must re-enter through a
+        fresh rendezvous)."""
+        if self._client is None:
+            return sorted(str(d) for d in observed)
+        if timeout_ms is None:
+            from paddle_tpu import flags as _flags
+
+            timeout_ms = _flags.get_flag("rpc_deadline_ms")
+        me = self.worker_index()
+        gen = self.generation()
+        cur = {str(d) for d in observed}
+        stable = 0.0
+        with _monitor.stall_guard("fleet.settle_dead"):
+            while stable < max_age_ms:
+                self.heartbeat()
+                _time.sleep(poll_ms / 1000.0)
+                nxt = cur | set(self._client.dead_peers(max_age_ms))
+                if nxt == cur:
+                    stable += poll_ms
+                else:
+                    stable, cur = 0.0, nxt
+            dead_ranks = {int(str(d).rsplit("-", 1)[-1]) for d in cur}
+            survivors = [r for r in range(self.worker_num())
+                         if r not in dead_ranks]
+            if not survivors:
+                raise ValueError(
+                    f"settle_dead: every rank is stale ({sorted(cur)})")
+            key = f"fleet/resize/dead/g{gen}"
+            if me == survivors[0]:
+                self.put(key, ",".join(sorted(cur)).encode())
+                dl = _retry.Deadline(timeout_ms / 1000.0)
+                for r in survivors[1:]:
+                    self.get(f"fleet/resize/ack/g{gen}/{r}",
+                             timeout_ms=max(1, dl.remaining_ms()))
+                return sorted(cur)
+            agreed = self.get(key, timeout_ms=timeout_ms).decode()
+            self.put(f"fleet/resize/ack/g{gen}/{me}", b"1")
+            return sorted(x for x in agreed.split(",") if x)
+
+    def plan_resize(self, dead_ids: Sequence, rank: Optional[int] = None,
+                    world: Optional[int] = None) -> dict:
+        """Deterministic shrunk-world spec for a resize after
+        ``barrier_or_dead`` reported ``dead_ids`` (``worker-<r>`` ids or
+        plain ranks; pass them through ``settle_dead`` first so every
+        survivor plans from the SAME set). Every survivor derives the
+        identical plan from the same dead set — survivors keep their
+        relative rank order. Chaos plans can tear this step via the
+        ``fleet.resize`` site (a raise here models a survivor that
+        fails during the resize decision).
+
+        Returns ``{"survivors": [old ranks], "rank": my new rank,
+        "world": new size, "dead": [dead old ranks]}``.
+        """
+        _F_RESIZE.hit()
+        world = self.worker_num() if world is None else int(world)
+        rank = self.worker_index() if rank is None else int(rank)
+        dead = set()
+        for d in dead_ids:
+            if isinstance(d, int):
+                dead.add(d)
+            else:
+                # "worker-3" and plain "3" both parse (settle_dead's
+                # client-less fallback stringifies whatever it was fed)
+                dead.add(int(str(d).rsplit("-", 1)[-1]))
+        survivors = [r for r in range(world) if r not in dead]
+        if rank not in survivors:
+            raise ValueError(
+                f"rank {rank} is itself in the dead set {sorted(dead)}; "
+                f"a declared-dead worker must not plan the resize")
+        if not survivors:
+            raise ValueError(f"resize with no survivors (dead: {sorted(dead)})")
+        return {"survivors": survivors, "rank": survivors.index(rank),
+                "world": len(survivors), "dead": sorted(dead)}
+
+    def reexec_resized(self, spec: dict, coord_endpoint: str,
+                       jax_endpoint: Optional[str] = None,
+                       script: Optional[str] = None,
+                       argv: Optional[Sequence[str]] = None,
+                       extra_env: Optional[dict] = None):
+        """Re-exec THIS process as generation N+1 of the shrunk world
+        described by ``plan_resize``'s spec: rank/world/coordination
+        endpoints land in the EnvRoleMaker env vars, PT_GEN increments,
+        the coord connection closes, and the process image is replaced
+        (``os.execve`` — no return). The restarted process's recovery
+        path (e.g. Trainer auto-resume or ``checkpoint.load_latest``)
+        then restores the newest valid checkpoint onto the NEW topology:
+        manifest-v2 checkpoints reassemble and re-shard on any world
+        shape, which is what makes this resize safe.
+
+        The command line survives the re-exec: ``argv`` defaults to
+        ``sys.argv[1:]``, so a job launched with flags restarts with the
+        same flags (hyperparameters must not silently reset to defaults
+        across generations). A ``python -m pkg.mod`` entrypoint re-runs
+        as a plain script path — pass ``script``/``argv`` explicitly if
+        your ``__main__`` relies on package-relative imports."""
+        env = dict(_os.environ)
+        env.update({
+            "PT_TRAINER_ID": str(spec["rank"]),
+            "PT_TRAINERS": str(spec["world"]),
+            "PT_COORD_ENDPOINT": coord_endpoint,
+            "PT_GEN": str(self.generation() + 1),
+        })
+        if jax_endpoint:
+            env["PT_JAX_COORD_ENDPOINT"] = jax_endpoint
+        if extra_env:
+            env.update({k: str(v) for k, v in extra_env.items()})
+        _M_RESIZES.inc()
+        self.stop_worker()
+        script = script or _os.path.abspath(_sys.argv[0])
+        args = list(_sys.argv[1:] if argv is None else argv)
+        _os.execve(_sys.executable, [_sys.executable, script] + args, env)
 
     # --- program compilation over the global mesh ---
 

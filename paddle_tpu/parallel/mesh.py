@@ -107,6 +107,53 @@ def axis_sizes(mesh: Mesh) -> Dict[str, int]:
     return {a: int(mesh.shape[a]) for a in mesh.axis_names}
 
 
+def sharding_descriptor(sharding) -> Optional[dict]:
+    """JSON-able description of a NamedSharding — the manifest-v2 field
+    that makes checkpoints mesh-portable: ``{"mesh": {axis -> size},
+    "spec": [per-dim axis list | None, ...]}``. Device identity is
+    deliberately NOT recorded (it is exactly what a restore onto a
+    different topology must ignore); axis names + sizes + the partition
+    spec are the whole layout. Non-Named shardings (positional/GSPMD) and
+    host values return None — their checkpoints still restore, they just
+    cannot advertise a layout to rebuild."""
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+    except ImportError:  # pragma: no cover
+        return None
+    if not isinstance(sharding, NamedSharding):
+        return None
+    spec = []
+    for e in tuple(PartitionSpec(*sharding.spec)):
+        if e is None:
+            spec.append(None)
+        elif isinstance(e, (tuple, list)):
+            spec.append([str(a) for a in e])
+        else:
+            spec.append([str(e)])
+    return {"mesh": axis_sizes(sharding.mesh), "spec": spec}
+
+
+def sharding_from_descriptor(desc: dict, devices=None):
+    """Rebuild a NamedSharding from a manifest-v2 descriptor over THIS
+    process's devices (or ``devices``). The reconstructed mesh shares
+    only axis names/sizes with the saving one — which is all a layout
+    is; use it to restore a checkpoint in its original sharding when the
+    restoring program has no strategy of its own."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    mesh = create_mesh(dict(desc["mesh"]), devices=devices,
+                       set_as_default=False)
+    entries = []
+    for e in desc["spec"]:
+        if e is None:
+            entries.append(None)
+        elif len(e) == 1:
+            entries.append(e[0])
+        else:
+            entries.append(tuple(e))
+    return NamedSharding(mesh, PartitionSpec(*entries))
+
+
 def set_mesh(mesh: Optional[Mesh]):
     global _current_mesh
     _current_mesh = mesh

@@ -39,6 +39,28 @@ _sleep = time.sleep
 _DEFAULT_RETRY_ON: Tuple[Type[BaseException], ...] = (OSError, TimeoutError)
 
 
+class Deadline:
+    """One monotonic budget shared by the SEQUENTIAL calls of a logical
+    operation — e.g. the checkpoint commit barrier collecting one ack
+    per writer: each call takes ``remaining()`` as ITS timeout, so the
+    operation as a whole honors the budget instead of each step getting
+    the full budget afresh (N x timeout in the worst case)."""
+
+    __slots__ = ("_at",)
+
+    def __init__(self, budget_s: float):
+        self._at = time.monotonic() + float(budget_s)
+
+    def remaining(self) -> float:
+        return max(0.0, self._at - time.monotonic())
+
+    def remaining_ms(self) -> int:
+        return int(self.remaining() * 1000)
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self._at
+
+
 class RetryPolicy:
     """Backoff parameters; stateless across calls (each ``call`` keeps
     its own attempt counter and sleep history)."""

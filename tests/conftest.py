@@ -71,6 +71,11 @@ def pytest_configure(config):
         "regression gate (which runs -m 'not slow')")
     config.addinivalue_line(
         "markers",
+        "chaos: multi-process chaos drill (fault-plan-driven kills, "
+        "re-exec recovery) — deterministic but expensive; deselected "
+        "from every default tier, run with -m chaos")
+    config.addinivalue_line(
+        "markers",
         "multidevice_fragile: quarantined TP-sharded 8-device pjit test "
         "— the environment's glibc heap-corruption crash (reproduces at "
         "the seed tree; see ROADMAP watch item) aborts the whole pytest "
@@ -87,6 +92,10 @@ def pytest_collection_modifyitems(config, items):
     if os.environ.get("PT_TEST_MULTIDEVICE") != "1" and \
             "multidevice_fragile" not in markexpr:
         drop.add("multidevice_fragile")
+    # chaos drills spawn whole process fleets: never part of a default
+    # tier (including --full); select explicitly with -m chaos
+    if "chaos" not in markexpr:
+        drop.add("chaos")
     if not (config.getoption("--full")
             or os.environ.get("PT_TEST_TIER") == "full"):
         # default smoke tier drops 'full' AND 'slow' (unless the

@@ -1,0 +1,184 @@
+"""Elastic-resize fleet worker for the 8->4 shrink chaos test (ISSUE 7;
+SURVEY.md §5 failure detection/recovery + ROADMAP item 3 elastic resize).
+
+Generation 0: 8 workers train; EVERY worker participates in the
+per-step coordinated checkpoint save (the multi-host commit barrier:
+non-zero ranks write their manifest fragment + shard file, ack over the
+fleet KV, rank 0 publishes only after all acks). The victims die at the
+start of a chosen step, driven by a SEEDED fault plan
+(`elastic.step:raise@N` via PT_FLAGS_fault_plan, so the chaos run
+replays exactly); only their heartbeats going stale reveals the deaths.
+Survivors' ``fleet.barrier_or_dead`` returns the dead ids; each derives
+the SAME shrunk world via ``fleet.plan_resize`` and re-execs itself
+through ``fleet.reexec_resized`` (generation 1, pre-provisioned
+recovery endpoints).
+
+Generation 1: 4 workers rendezvous fresh, restore the newest VALID
+checkpoint via ``checkpoint.load_latest`` — committed by an 8-writer
+world (8 manifest fragments + 8 shard files), reassembled by a 4-worker
+one — and finish the remaining steps, so the harness can assert loss
+parity against an uninterrupted single-process run.
+
+Compute is REPLICATED (every worker runs the full global batch on its
+local device): this environment's jax/CPU build cannot execute
+multiprocess XLA computations (the same pre-existing wall behind the
+test_fleet/test_fleet_recovery parity failures), and the drill's
+subject is the host-side recovery plane — seeded kill, stale-heartbeat
+detection, resize agreement, re-exec, commit barrier, cross-world
+restore. Bit-exact SHARDED save-on-A/restore-on-B is proven in-process
+by the mesh matrix in tests/test_checkpoint.py.
+
+Run (harness: tests/test_elastic_resize.py):
+  PT_TRAINER_ID=r PT_TRAINERS=8 PT_COORD_ENDPOINT=127.0.0.1:p
+  PT_RECOVER_PORT=p2 PT_RECOVER_JAX_PORT=p3 PT_CKPT_DIR=dir
+  PT_FLAGS_fault_plan='elastic.step:raise@3'  # victims only
+  python fleet_resize_worker.py
+"""
+
+import json
+import os
+
+import jax
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 1)
+    except AttributeError:
+        # older jax (< 0.5): virtual-device count is an XLA flag
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=1")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import faults, layers  # noqa: E402
+from paddle_tpu.executor import global_scope  # noqa: E402
+from paddle_tpu.incubate.fleet import fleet  # noqa: E402
+from paddle_tpu.parallel import checkpoint as ckpt  # noqa: E402
+
+GLOBAL_BATCH = 24
+STEPS = 6
+DIM, HID, CLS = 16, 32, 4
+
+# the victims' seeded fault plan raises here (PT_FLAGS_fault_plan armed
+# the site at import); survivors' plans are empty
+_F_STEP = faults.site("elastic.step")
+
+
+def deterministic_params():
+    r = np.random.RandomState(11)
+    return (
+        r.normal(0, 0.1, (DIM, HID)).astype(np.float32),
+        np.zeros(HID, np.float32),
+        r.normal(0, 0.1, (HID, CLS)).astype(np.float32),
+        np.zeros(CLS, np.float32),
+    )
+
+
+def global_batches():
+    rng = np.random.RandomState(3)
+    probe = np.random.RandomState(5).randn(DIM, CLS)
+    out = []
+    for _ in range(STEPS):
+        x = rng.randn(GLOBAL_BATCH, DIM).astype(np.float32)
+        y = np.argmax(x @ probe, 1).astype(np.int64)[:, None]
+        out.append((x, y))
+    return out
+
+
+def build():
+    w1, b1, w2, b2 = deterministic_params()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=[DIM], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        h = layers.fc(
+            img, HID, act="relu",
+            param_attr=fluid.ParamAttr(
+                name="w1",
+                initializer=fluid.initializer.NumpyArrayInitializer(w1)),
+            bias_attr=fluid.ParamAttr(
+                name="b1",
+                initializer=fluid.initializer.NumpyArrayInitializer(b1)),
+        )
+        logits = layers.fc(
+            h, CLS,
+            param_attr=fluid.ParamAttr(
+                name="w2",
+                initializer=fluid.initializer.NumpyArrayInitializer(w2)),
+            bias_attr=fluid.ParamAttr(
+                name="b2",
+                initializer=fluid.initializer.NumpyArrayInitializer(b2)),
+        )
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    gen = fleet.generation()
+    ckpt_dir = os.environ["PT_CKPT_DIR"]
+
+    fleet.init()
+    rank, n = fleet.worker_index(), fleet.worker_num()
+
+    main_prog, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    start_step = 0
+    if gen == 1:
+        # cross-world restore: serials were committed by the LARGER
+        # world (one manifest fragment + shard file per old rank);
+        # load_latest reassembles them regardless of who saved
+        loaded = ckpt.load_latest(ckpt_dir)
+        assert loaded is not None, "no valid checkpoint after shrink"
+        start_step = loaded[0]
+        scope = global_scope()
+        for k, v in loaded[1].items():
+            scope.set(k, v)
+
+    host = os.environ["PT_COORD_ENDPOINT"].rsplit(":", 1)[0]
+    losses = []
+    batches = global_batches()
+    for i in range(start_step, STEPS):
+        try:
+            _F_STEP.hit()  # victims' seeded plan kills them HERE
+        except faults.InjectedFault:
+            os._exit(1)  # abrupt death: heartbeat goes stale, no farewell
+        dead = fleet.barrier_or_dead(f"step{i}-g{gen}", max_age_ms=1500)
+        if dead:
+            # simultaneous deaths go stale at different poll instants:
+            # settle + agree on ONE dead set before planning the world
+            dead = fleet.settle_dead(dead, max_age_ms=1500)
+            spec = fleet.plan_resize(dead)
+            fleet.reexec_resized(
+                spec,
+                coord_endpoint=f"{host}:{os.environ['PT_RECOVER_PORT']}",
+                jax_endpoint=f"{host}:{os.environ['PT_RECOVER_JAX_PORT']}",
+                extra_env={"PT_DEAD_SEEN": ",".join(
+                    sorted(str(d) for d in dead))},
+            )
+        x, y = batches[i]
+        out = exe.run(main_prog, feed={"img": x, "label": y},
+                      fetch_list=[loss])
+        losses.append(float(out[0]))
+        fleet.heartbeat()
+        # EVERY rank joins the coordinated save (commit barrier): rank 0
+        # publishes only after all acks, so a committed serial always
+        # holds every writer's fragments
+        ckpt.save_scope(ckpt_dir, step=i + 1)
+
+    print("FLEET_RESULT " + json.dumps({
+        "rank": rank, "gen": gen, "world": n, "start_step": start_step,
+        "dead_seen": os.environ.get("PT_DEAD_SEEN", "").split(",")
+        if os.environ.get("PT_DEAD_SEEN") else [],
+        "losses": losses}), flush=True)
+    fleet.barrier(f"done-g{gen}")
+    fleet.stop_worker()
+
+
+if __name__ == "__main__":
+    main()

@@ -200,9 +200,12 @@ def test_builtin_sites_registered():
     import paddle_tpu.parallel.checkpoint  # noqa: F401
 
     names = set(faults.sites())
-    assert {"ckpt.write_shards", "ckpt.commit", "fleet.kv_get",
-            "fleet.kv_put", "fleet.connect", "fleet.heartbeat",
+    assert {"ckpt.write_shards", "ckpt.commit", "ckpt.read",
+            "fleet.kv_get", "fleet.kv_put", "fleet.connect",
+            "fleet.heartbeat", "fleet.resize",
             "reader.next", "io.export"} <= names
+    # the documented registry stays in sync with the declarations
+    assert set(faults.BUILTIN_SITES) <= names
 
 
 # --------------------------------------------------------------------------
