@@ -132,7 +132,8 @@ def main():
 
         try:
             env = {**os.environ, "PT_BENCH_RESNET": "0",
-                   "PT_BENCH_LONGCTX": "0", **env_extra}
+                   "PT_BENCH_LONGCTX": "0", "PT_BENCH_WARMSTART": "0",
+                   **env_extra}
             out = subprocess.run(argv, capture_output=True, text=True,
                                  timeout=900, env=env)
             if out.returncode != 0:
@@ -151,7 +152,7 @@ def main():
                 for k in ("resnet50", "long_context_t1024",
                           "long_context_t4096", "long_context_t8192",
                           "se_resnext50",
-                          "bert_base", "deepfm", "ssd300"):
+                          "bert_base", "deepfm", "ssd300", "warm_start"):
                     parsed.pop(k, None)
             return parsed
         except Exception as e:  # never let a rider kill the headline
@@ -164,7 +165,8 @@ def main():
     want_resnet = os.environ.get("PT_BENCH_RESNET", "1") == "1"
     want_longctx = os.environ.get("PT_BENCH_LONGCTX", "1") == "1"
     want_families = os.environ.get("PT_BENCH_FAMILIES", "1") == "1"
-    if want_resnet or want_longctx or want_families:
+    want_warmstart = os.environ.get("PT_BENCH_WARMSTART", "1") == "1"
+    if want_resnet or want_longctx or want_families or want_warmstart:
         del feeds
         fluid.executor.global_scope().clear()
         exe.close()
@@ -190,6 +192,14 @@ def main():
     longctx = longctx_rows.get("1024")
     longctx4k = longctx_rows.get("4096")
     longctx8k = longctx_rows.get("8192")
+    warm_start = None
+    if want_warmstart:
+        # cold-vs-warm start through the persistent compile cache: two
+        # fresh children against one fresh cache dir; the second must
+        # resolve every executable from disk (zero fresh XLA compiles)
+        warm_start = _rider(
+            [sys.executable, os.path.join(here, "bench_warmstart.py")], {})
+        log(f"warm_start: {warm_start}")
     if want_families:
         # remaining BASELINE.md rows, one fresh process per family
         for fam, env in (
@@ -219,6 +229,7 @@ def main():
         "bert_base": families.get("bert"),
         "deepfm": families.get("deepfm"),
         "ssd300": families.get("ssd300"),
+        "warm_start": warm_start,
     }))
 
 

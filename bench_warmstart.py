@@ -1,0 +1,124 @@
+"""Benchmark rider: cold vs warm start through the persistent compile
+cache (compile_cache.py).
+
+Launches the SAME child twice against one fresh ``compile_cache_dir``:
+the first (cold) child traces + XLA-compiles the bench transformer and
+publishes serialized executables; the second (warm) child is a fresh
+process that must resolve every executor entry from disk — zero fresh
+XLA compiles — and reach its first executed train step in a fraction of
+the cold time.
+
+Prints ONE JSON line in the driver format: ``value`` is the warm
+compile+first-step wall seconds, ``vs_baseline`` is
+``(0.10 * cold) / warm`` against the acceptance target "warm start
+<= 10% of cold" (>1.0 beats the target). The cold seconds, the warm
+child's hit/miss counters and its per-entry cache outcomes ride along
+so the driver can verify the zero-fresh-compiles claim, not just the
+wall time.
+
+Env knobs: ``PT_BENCH_BATCH``/``PT_BENCH_SEQ`` (bench.py's transformer
+shape), ``PT_BENCH_CPU=1`` to force the CPU backend (fast smoke — the
+hosted-TPU plugin overrides JAX_PLATFORMS, so this must be set in
+Python before first device use).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+BATCH = int(os.environ.get("PT_BENCH_BATCH", "64"))
+SEQ = int(os.environ.get("PT_BENCH_SEQ", "256"))
+VOCAB = 10000
+
+
+def _configure_platform():
+    if os.environ.get("PT_BENCH_CPU", "0") != "1":
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def child(cache_dir: str):
+    """One fresh process: build the bench transformer, run startup + one
+    train step with the persistent cache at ``cache_dir``, print the
+    compile+first-step wall seconds and the cache accounting."""
+    _configure_platform()
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import compile_cache, flags, monitor
+    from paddle_tpu.models import transformer as T
+
+    flags.set_flags({"telemetry": True, "compile_cache_dir": cache_dir})
+    cfg = T.TransformerConfig(
+        src_vocab_size=VOCAB,
+        trg_vocab_size=VOCAB,
+        max_length=SEQ + 2,
+        d_model=512,
+        d_inner=2048,
+        n_head=8,
+        n_layer=6,
+        dropout=0.1,
+    )
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        model = T.build(cfg)
+        fluid.optimizer.Adam(1e-4).minimize(model["loss"])
+    main_prog._amp = True
+    batch = T.make_batch(cfg, BATCH, SEQ, SEQ, seed=0)
+    t0 = time.perf_counter()
+    exe = fluid.Executor()
+    exe.run(startup)
+    out = exe.run(main_prog, feed=batch, fetch_list=[model["loss"]])
+    loss = float(np.asarray(out[0]))  # forces the step to materialize
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "compile_first_step_s": dt,
+        "loss": loss,
+        "stats": compile_cache.stats(),
+        "outcomes": [r["cache"] for r in monitor.recent_steps()],
+    }))
+
+
+def _launch(cache_dir: str) -> dict:
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", cache_dir],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "PT_BENCH_WARMSTART": "0"})
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"warm-start child rc={out.returncode}, "
+            f"stderr tail: {out.stderr[-1000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    cache_dir = tempfile.mkdtemp(prefix="pt_warmstart_cc_")
+    cold = _launch(cache_dir)
+    warm = _launch(cache_dir)
+    cold_s, warm_s = cold["compile_first_step_s"], warm["compile_first_step_s"]
+    print(json.dumps({
+        "metric": "transformer_warm_start_compile_first_step_seconds",
+        "value": round(warm_s, 3),
+        "unit": "s",
+        # target: warm <= 10% of cold; >1.0 beats it
+        "vs_baseline": round((0.10 * cold_s) / warm_s, 3) if warm_s else 0.0,
+        "cold_s": round(cold_s, 3),
+        "warm_hits": warm["stats"]["hits"],
+        "warm_misses": warm["stats"]["misses"],
+        "warm_errors": warm["stats"]["errors"],
+        "warm_outcomes": warm["outcomes"],
+    }))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child(sys.argv[2])
+    else:
+        main()

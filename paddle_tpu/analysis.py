@@ -64,6 +64,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu import compile_cache as _ccache
 from paddle_tpu import flags as _flags
 from paddle_tpu import monitor as _monitor
 from paddle_tpu.framework import (
@@ -1116,9 +1117,11 @@ def lint_active() -> bool:
     return _mode != "off"
 
 
-# (uid, version, feeds, fetches, strategy-id) fingerprints already
-# linted pre-compile: a recompile of the same signature never re-lints
-_SEEN: "collections.OrderedDict[tuple, bool]" = collections.OrderedDict()
+# Canonical (compile_cache.program_fingerprint) signatures already
+# linted pre-compile: a recompile of the same signature never re-lints.
+# Content-keyed like the executor/compile caches — two identically-built
+# programs share one lint run.
+_SEEN: "collections.OrderedDict[str, bool]" = collections.OrderedDict()
 _SEEN_CAP = 512
 
 
@@ -1133,27 +1136,13 @@ def _dispatch(findings: List[Finding], site: str):
 
 
 def _strategy_token(strategy) -> tuple:
-    """Content fingerprint of a DistributedStrategy for the _SEEN keys.
-    id() would alias a fresh strategy to a GC-reused address (the same
-    hazard executor._latest_stacked pins references against); content
-    keying also lets two equal strategies share one lint run."""
-    if strategy is None:
-        return ()
-    mesh = getattr(strategy, "mesh", None)
-    return (
-        tuple(sorted((a, int(mesh.shape[a])) for a in mesh.axis_names))
-        if mesh is not None else None,
-        getattr(strategy, "data_axis", None),
-        getattr(strategy, "slice_axis", None),
-        getattr(strategy, "context_axis", None),
-        getattr(strategy, "table_axis", None),
-        getattr(strategy, "expert_axis", None),
-        getattr(strategy, "pipe_axis", None),
-        getattr(strategy, "pipe_micro", None),
-        bool(getattr(strategy, "strict", False)),
-        tuple((r.pattern, str(r.spec))
-              for r in getattr(strategy, "rules", ())),
-    )
+    """Content fingerprint of a DistributedStrategy — THE canonical one
+    (compile_cache.strategy_token), shared with the executor cache key
+    and the persistent compile cache so the three subsystems can never
+    drift. id() would alias a fresh strategy to a GC-reused address (the
+    same hazard executor._latest_stacked pins references against);
+    content keying also lets two equal strategies share one lint run."""
+    return _ccache.strategy_token(strategy)
 
 
 def lint_before_compile(program: Program,
@@ -1165,8 +1154,11 @@ def lint_before_compile(program: Program,
     strategy) fingerprint, right before the first compile of that
     signature. Logs warning/error findings; raises LintError under
     ``static_lint=error``. Callers must gate on ``lint_active()``."""
-    key = (program._uid, program.version, tuple(feed_names),
-           tuple(fetch_names), _strategy_token(strategy))
+    key = _ccache.fingerprint_for(
+        ("lint", program._uid, program.version, tuple(feed_names),
+         tuple(fetch_names), _strategy_token(strategy)),
+        program, strategy=strategy, feed_sig=tuple(feed_names),
+        fetch_names=fetch_names, extra=("lint",))
     if key in _SEEN:
         return
     findings = lint(program, feeds=feed_names, fetches=fetch_names,
@@ -1188,8 +1180,10 @@ def lint_at_build(program: Program, strategy=None,
     on ``lint_active()`` internally — call sites stay one-liners."""
     if not lint_active():
         return
-    key = (program._uid, program.version, site,
-           _strategy_token(strategy))
+    key = _ccache.fingerprint_for(
+        ("lint-build", program._uid, program.version, site,
+         _strategy_token(strategy)),
+        program, strategy=strategy, extra=("lint-build", site))
     if key in _SEEN:
         return
     findings = lint(program, strategy=strategy, checks=checks,

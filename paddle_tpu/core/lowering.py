@@ -352,7 +352,12 @@ def build_compile_report(
     }
     try:
         t0 = _time.perf_counter()
-        compiled = jitfn.lower(*args).compile()
+        # an entry built through the persistent compile cache carries
+        # its AOT executable (compile_cache._wrap): analyze that instead
+        # of AOT-compiling a twin
+        compiled = getattr(jitfn, "_pt_compiled", None)
+        if compiled is None:
+            compiled = jitfn.lower(*args).compile()
         report["analysis_ms"] = (_time.perf_counter() - t0) * 1e3
     except Exception:
         return report

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu import analysis as _analysis
+from paddle_tpu import compile_cache as _ccache
 from paddle_tpu import monitor as _monitor
 from paddle_tpu import numerics as _numerics
 from paddle_tpu.core import lowering
@@ -153,6 +154,11 @@ class Executor:
         # (host array refs — pinned so id identity stays valid, stacked
         # device arrays)
         self._latest_stacked: Optional[tuple] = None
+        # the compiled-cache key whose entry last used the staging slot;
+        # evicting that entry also clears the slot (stale staging would
+        # pin whole device-resident feed windows after the compiled
+        # entry is gone)
+        self._latest_stacked_key: Optional[tuple] = None
 
     # --- public API ---
 
@@ -201,19 +207,41 @@ class Executor:
         sig = tuple(
             (k, tuple(np.shape(v)), str(jnp.result_type(v))) for k, v in feed_vals.items()
         )
-        key = (
+        # Canonical fingerprint (compile_cache.program_fingerprint):
+        # content-keyed, shared with the lint-once cache, the compile
+        # report cache_key, and the persistent disk tier. The memo keyed
+        # by this cheap identity tuple keeps the hot path at one dict
+        # read (program._amp is identity-relevant: flipping it does NOT
+        # bump the version).
+        ident = (
             program._uid,
             program.version,
             getattr(program, "_amp", False),
             compiled._uid if compiled is not None else 0,
             sig,
             tuple(run_fetch_names),
-            scope._uid,
         )
+        fp = _ccache.fingerprint_for(ident, program, compiled=compiled,
+                                     feed_sig=sig,
+                                     fetch_names=run_fetch_names)
+        key = (fp, scope._uid)
+
         def build():
             return self._compile(
                 program, compiled, feed_names, run_fetch_names, scope
             )
+
+        spec_factory = None
+        if use_program_cache and _ccache.active():
+            # level-2 disk tier: the spec (state avals gathered from the
+            # scope, digest, example args) is only built on a level-1
+            # miss — see _cache_entry
+            def spec_factory():
+                return _ccache.executor_spec(
+                    program, feed_vals=feed_vals,
+                    fetch_names=run_fetch_names, scope=scope,
+                    base_key=self._base_key_for(program),
+                    fingerprint=fp, compiled=compiled)
 
         if _analysis.lint_active():
             # static verifier BEFORE the first compile of this signature
@@ -234,11 +262,12 @@ class Executor:
             _monitor.check_memory_budget(
                 program, {k: np.shape(v) for k, v in feed_vals.items()})
         if use_program_cache:
-            entry, cache_hit, evictions, compile_ms = self._cache_entry(
-                key, build)
+            entry, outcome, evictions, compile_ms = self._cache_entry(
+                key, build, spec_factory)
         else:
             entry, compile_ms = self._timed_build(build)
-            cache_hit, evictions = False, 0
+            outcome, evictions = "miss", 0
+        cache_hit = outcome != "miss"
         fn, lowered = entry
 
         state = self._gather_state(scope, lowered)
@@ -316,13 +345,13 @@ class Executor:
                             program=program, kind="step",
                             compile_ms=compile_ms,
                             strategy=strat_label,
-                            cache_key=key))
+                            cache_key=fp))
             if _monitor.step_records_active():
                 rec = {
                     "kind": "step",
                     "step": step_idx,
                     "compile_ms": compile_ms,
-                    "cache": "hit" if cache_hit else "miss",
+                    "cache": outcome,
                     "evictions": evictions,
                     "feed_bytes": feed_bytes,
                     "fetch_bytes": 0,
@@ -479,17 +508,42 @@ class Executor:
             (k, tuple(v.shape), str(v.dtype)) for k, v in sorted(
                 stacked.items())
         )
-        key = (
+        # Canonical fingerprint (see run()); the window variant folds in
+        # the feed-rotation length and the nan-track flavor. ``steps``
+        # rides the L1 KEY, not the fingerprint content hash: the jit
+        # treats it as a static argument, but a disk-resolved executable
+        # bakes it in, so entries must be steps-distinct end to end.
+        ident = (
             "multi", program._uid, program.version,
             getattr(program, "_amp", False), len(feed_list), sig,
-            tuple(run_fetch_names), scope._uid, nan_track,
+            tuple(run_fetch_names), nan_track,
         )
+        fp = _ccache.fingerprint_for(
+            ident, program, feed_sig=sig, fetch_names=run_fetch_names,
+            extra=("multi", len(feed_list), bool(nan_track)))
+        key = (fp, scope._uid, int(steps))
+        if cacheable and self._latest_stacked is not None:
+            # eviction coupling: remember which compiled entry owns the
+            # staged window (see _cache_entry)
+            self._latest_stacked_key = key
+
         def build():
             lowered = lowering.lower_block(program, 0, feed_names,
                                            run_fetch_names)
             return (lowering.jit_lowered_multi(lowered, len(feed_list),
                                                track_nonfinite=nan_track),
                     lowered)
+
+        spec_factory = None
+        if _ccache.active():
+            # level-2 disk tier (see run()): built only on a level-1 miss
+            def spec_factory():
+                return _ccache.executor_spec(
+                    program, feed_vals=stacked,
+                    fetch_names=run_fetch_names, scope=scope,
+                    base_key=self._base_key_for(program),
+                    fingerprint=fp, window_steps=int(steps),
+                    n_feeds=len(feed_list), nan_track=nan_track)
 
         if _analysis.lint_active():
             # static verifier before the window's first compile (run()
@@ -505,8 +559,9 @@ class Executor:
             _monitor.check_memory_budget(
                 program,
                 {k: tuple(v.shape[1:]) for k, v in stacked.items()})
-        entry, cache_hit, evictions, compile_ms = self._cache_entry(
-            key, build)
+        entry, outcome, evictions, compile_ms = self._cache_entry(
+            key, build, spec_factory)
+        cache_hit = outcome != "miss"
         fn, lowered = entry
         state = self._gather_state(scope, lowered)
         base_key = self._base_key_for(program)
@@ -525,14 +580,14 @@ class Executor:
                          int(steps)),
                         program=program, kind="window",
                         compile_ms=compile_ms, strategy=None,
-                        cache_key=key))
+                        cache_key=fp))
             if _monitor.step_records_active():
                 rec = {
                     "kind": "window",
                     "step": start,
                     "steps": int(steps),
                     "compile_ms": compile_ms,
-                    "cache": "hit" if cache_hit else "miss",
+                    "cache": outcome,
                     "evictions": evictions,
                     "feed_bytes": feed_bytes,
                     "fetch_bytes": 0,
@@ -602,32 +657,68 @@ class Executor:
 
     # --- shared plumbing for run()/run_steps() ---
 
-    def _cache_entry(self, key, build):
-        """LRU lookup-or-build with the capacity eviction policy.
+    def _cache_entry(self, key, build, spec_factory=None):
+        """LRU lookup-or-build with the capacity eviction policy and the
+        persistent level-2 tier (compile_cache.py) between them.
 
-        Returns ``(entry, hit, evictions, compile_ms)`` — the cache
-        outcome rides the return value (not instance state) so the
-        step-log assembly can never read a stale previous call's
-        outcome."""
+        Returns ``(entry, outcome, evictions, compile_ms)`` where
+        ``outcome`` is ``"hit"`` (in-memory), ``"disk"`` (executable
+        deserialized from the persistent cache — no trace, no XLA
+        compile; ``compile_ms`` is then the load time) or ``"miss"``
+        (fresh compile). The outcome rides the return value (not
+        instance state) so the step-log assembly can never read a stale
+        previous call's outcome. ``spec_factory`` — passed only while
+        the disk tier is active — builds the disk-resolution spec
+        lazily: a level-1 hit never pays for it."""
         entry = self._cache.get(key)
         if entry is not None:
             self._cache.pop(key)
             self._cache[key] = entry  # refresh so eviction drops coldest
             _M_CACHE_HITS.inc()
-            return entry, True, 0, None
+            return entry, "hit", 0, None
         _M_CACHE_MISSES.inc()
-        entry, compile_ms = self._timed_build(build)
+        outcome = "miss"
+        entry = compile_ms = None
+        spec = spec_factory() if spec_factory is not None else None
+        if spec is not None:
+            loaded = _ccache.load(spec)
+            if loaded is not None:
+                fn, compile_ms = loaded
+                # block analysis only — a disk hit never traces
+                entry = (fn, spec.make_lowered())
+                outcome = "disk"
+        if entry is None:
+            if spec is not None:
+                # disk miss with the tier on: AOT-compile through the
+                # spec (one trace + one XLA compile — the same cost the
+                # eager jit would pay lazily) and persist the executable
+                # for the next process; an AOT failure keeps the eager
+                # jit and stores nothing.
+                def build_aot(_build=build):
+                    fn, lowered = _build()
+                    aot = _ccache.aot_build(spec, fn)
+                    return (fn if aot is None else aot), lowered
+
+                entry, compile_ms = self._timed_build(build_aot)
+            else:
+                entry, compile_ms = self._timed_build(build)
         self._cache[key] = entry
         from paddle_tpu import flags as _flags_mod
 
         cap = _flags_mod.get_flag("executor_cache_capacity")
         evicted = 0
         while cap > 0 and len(self._cache) > cap:
-            self._cache.pop(next(iter(self._cache)))
+            victim = next(iter(self._cache))
+            self._cache.pop(victim)
+            if victim == self._latest_stacked_key:
+                # the staged feed window must not outlive its owning
+                # compiled entry (see _latest_stacked_key)
+                self._latest_stacked = None
+                self._latest_stacked_key = None
             evicted += 1
         if evicted:
             _M_CACHE_EVICTIONS.inc(evicted)
-        return entry, False, evicted, compile_ms
+        return entry, outcome, evicted, compile_ms
 
     def _timed_build(self, build):
         """Compile under the unified span; returns ``(entry,
@@ -802,6 +893,9 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        # staging follows its owning entries out (see _cache_entry)
+        self._latest_stacked = None
+        self._latest_stacked_key = None
 
     @staticmethod
     def _check_nan_inf(fetch_names, fetches, new_state):

@@ -161,6 +161,12 @@ BUILTIN_SITES = {
                     "detection (fleet_base.plan_resize)",
     "reader.next": "trainer batch fetch (contrib/trainer.py)",
     "io.export": "inference-model export publish (io.py)",
+    "ccache.load": "persistent compile-cache entry read, pre-deserialize "
+                   "(compile_cache.load; truncate = corrupt published "
+                   "entry, which must degrade to a metered miss)",
+    "ccache.store": "persistent compile-cache staged write, pre-rename "
+                    "(compile_cache.store; raise/truncate = torn store — "
+                    "the atomic publish must leave no torn entry)",
 }
 
 

@@ -103,6 +103,14 @@ _DEFS: Dict[str, tuple] = {
     # derived from (seed, site name), so a seeded chaos run reproduces
     # its fault sequence exactly
     "fault_seed": (int, 0, "seed for probabilistic fault-plan triggers"),
+    # persistent level-2 compile cache (compile_cache.py): serialized
+    # AOT executables resolved from this directory BEFORE tracing, so a
+    # fresh process warm-starts a known program in seconds instead of
+    # minutes; entries are keyed by a canonical content fingerprint +
+    # environment token and written atomically. Also points jax's own
+    # persistent compilation cache at <dir>/xla as a fallback tier.
+    # Empty = disabled (the executor hot path is one boolean check).
+    "compile_cache_dir": (str, "", "persistent compile-cache directory"),
     # pre-compile static program verifier (analysis.py): 'warn' lints
     # every program before its first compile and logs warning/error
     # findings; 'error' additionally raises LintError on error-severity

@@ -17,6 +17,8 @@ OpDescs into blocks of a serializable Program — but:
 
 from __future__ import annotations
 
+import hashlib
+
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -310,6 +312,22 @@ def _attr_from_proto(a: pb.OpDesc.Attr, program: "Program"):
     raise TypeError(f"unsupported proto attr type {t}")
 
 
+def _canonical_attr_bytes(val) -> bytes:
+    """Deterministic cross-process rendering of one op attr for
+    Program.content_digest. Blocks render as their index (the block
+    content itself is digested in block order), arrays as a data digest,
+    floats via repr (full precision)."""
+    if isinstance(val, Block):
+        return f"block:{val.idx}".encode()
+    if isinstance(val, np.ndarray):
+        return (f"ndarray:{val.shape}:{val.dtype}:"
+                f"{hashlib.sha256(np.ascontiguousarray(val).tobytes()).hexdigest()[:16]}"
+                ).encode()
+    if isinstance(val, (list, tuple)):
+        return b"[" + b",".join(_canonical_attr_bytes(x) for x in val) + b"]"
+    return repr(val).encode()  # floats via repr: full precision
+
+
 class Block:
     """An ordered op list + var table (reference: framework.py:1370)."""
 
@@ -532,6 +550,8 @@ class Program:
         # version-keyed def-use index cache (analysis.DefUseIndex per
         # block); every _bump_version invalidates it implicitly
         self._def_use_cache: Optional[tuple] = None
+        # version-keyed content digest cache (content_digest below)
+        self._content_digest_cache: Optional[tuple] = None
 
     def _bump_version(self):
         self._version += 1
@@ -576,6 +596,39 @@ class Program:
             self._def_use_cache = (
                 self._version, analysis.build_def_use(self))
         return self._def_use_cache[1]
+
+    def content_digest(self) -> str:
+        """sha256 hex digest of the program CONTENT — blocks, vars, op
+        list with slot-keyed args and canonicalized attrs, random_seed —
+        with no process-local identity (uids, ids) mixed in, so two
+        identically-built programs in two different processes digest
+        identically. Cached per version (any op append/rewrite bumps the
+        version and invalidates). The canonical program token of
+        ``compile_cache.program_fingerprint``."""
+        cache = self._content_digest_cache
+        if cache is not None and cache[0] == self._version:
+            return cache[1]
+        h = hashlib.sha256()
+        h.update(repr(self.random_seed).encode())
+        for b in self.blocks:
+            h.update(f"B{b.idx}:{b.parent_idx}".encode())
+            for name in sorted(b.vars):
+                v = b.vars[name]
+                h.update(repr((
+                    name, v.shape, str(v.dtype), bool(v.persistable),
+                    bool(v.stop_gradient), bool(v.is_parameter),
+                    v.kind,
+                )).encode())
+            for op in b.ops:
+                h.update(op.type.encode())
+                h.update(repr(sorted(op.inputs.items())).encode())
+                h.update(repr(sorted(op.outputs.items())).encode())
+                for k in sorted(op.attrs):
+                    h.update(k.encode())
+                    h.update(_canonical_attr_bytes(op.attrs[k]))
+        digest = h.hexdigest()
+        self._content_digest_cache = (self._version, digest)
+        return digest
 
     # --- serialization ---
 

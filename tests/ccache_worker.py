@@ -1,0 +1,58 @@
+"""Subprocess worker for the cross-process warm-start tests
+(tests/test_compile_cache.py): builds the same two-program pair (startup
++ train step) every invocation, runs one startup pass and one train
+step with the persistent compile cache pointed at ``argv[1]``, and
+prints ONE JSON line with the cache/executor accounting the parent
+asserts on.
+
+Determinism contract: the program built here must be content-identical
+across processes — that is the property the disk tier keys on.
+"""
+
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import compile_cache, flags, layers, monitor  # noqa: E402
+
+
+def main():
+    cache_dir, report_dir = sys.argv[1], sys.argv[2]
+    flags.set_flags({
+        "telemetry": True,
+        "compile_cache_dir": cache_dir,
+        "compile_report_dir": report_dir,
+    })
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        loss = layers.mean(layers.fc(x, 4))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out = exe.run(main_prog,
+                      feed={"x": np.ones((2, 8), np.float32)},
+                      fetch_list=[loss])
+        wout = exe.run_steps(main_prog,
+                             feed_list=[{"x": np.ones((2, 8), np.float32)}],
+                             steps=2, fetch_list=[loss])
+    print(json.dumps({
+        "stats": compile_cache.stats(),
+        "exec_misses":
+            monitor.counter("pt_executor_cache_misses_total").value(),
+        "outcomes": [r["cache"] for r in monitor.recent_steps()],
+        "loss": float(np.asarray(out[0])),
+        "window_loss": float(np.asarray(wout[0])),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -76,11 +76,15 @@ def pytest_configure(config):
         "from every default tier, run with -m chaos")
     config.addinivalue_line(
         "markers",
-        "multidevice_fragile: quarantined TP-sharded 8-device pjit test "
-        "— the environment's glibc heap-corruption crash (reproduces at "
-        "the seed tree; see ROADMAP watch item) aborts the whole pytest "
-        "process on the first such execution. Deselected by default; "
-        "run with PT_TEST_MULTIDEVICE=1 or an explicit -m expression")
+        "multidevice_fragile: quarantined under the environment's glibc "
+        "heap-corruption crash (seeded by 8-device pjit executions; "
+        "reproduces at the seed tree — see ROADMAP watch item). The "
+        "corruption is heap-layout-sensitive, so the abort can land "
+        "either in a TP-sharded pjit execution itself or in a "
+        "downstream test's ordinary allocations; tests where a full "
+        "tier-1 run deterministically dies carry this marker. "
+        "Deselected by default; run with PT_TEST_MULTIDEVICE=1 or an "
+        "explicit -m expression")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1120,6 +1120,7 @@ def test_trainer_resized_resume_rederives_rng_cursor(tmp_path, monkeypatch):
         labels={"resized": "true"}) == r0 + 1
 
 
+@pytest.mark.multidevice_fragile
 def test_trainer_resume_settles_pending_save_with_one_retry(tmp_path):
     """One fault, one retry: a training failure that arrives while an
     overlapped save is ALSO failing in the background must not burn two

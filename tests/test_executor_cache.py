@@ -88,6 +88,44 @@ def test_capacity_eviction_fires_and_is_counted():
         assert len(exe._cache) == 1
 
 
+def test_capacity_eviction_clears_owned_feed_staging_slot():
+    """Evicting a run_steps entry at capacity also drops the single-slot
+    feed-staging cache it owns — stale staging would pin whole
+    device-resident feed windows after the compiled entry is gone (and
+    could never hit again without its entry). A victim that is NOT the
+    owner leaves the staging alone."""
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    frozen = np.arange(32, dtype=np.float32).reshape(4, 8).copy()
+    frozen.flags.writeable = False
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run_steps(main, feed_list=[{"x": frozen}], steps=2,
+                      fetch_list=[loss])
+        assert exe._latest_stacked is not None
+        assert exe._latest_stacked_key is not None
+        # shrink to capacity 1; the next insert (a fresh run signature)
+        # evicts both older entries, including the staging owner — the
+        # staged window must go with it
+        flags.set_flags({"executor_cache_capacity": 1})
+        exe.run(main, feed=_feed(), fetch_list=[loss])
+        assert exe._latest_stacked is None
+        assert exe._latest_stacked_key is None
+        # at capacity 2 with the window entry RECENT, evicting the
+        # older run() entry does not touch the window's staging
+        flags.set_flags({"executor_cache_capacity": 2})
+        exe.run_steps(main, feed_list=[{"x": frozen}], steps=2,
+                      fetch_list=[loss])  # cache: {run, window}
+        assert exe._latest_stacked is not None
+        exe.run(main, feed=_feed(), fetch_list=[])  # evicts the run entry
+        assert exe._latest_stacked is not None
+        assert len(exe._cache) == 2
+        exe.close()  # close drops staging with the entries
+        assert exe._latest_stacked is None
+        assert exe._latest_stacked_key is None
+
+
 def test_failing_step_still_logs_a_record(tmp_path):
     """A raising step (here: NaN scan) must still append its step-log
     record — the crashed step is the record a postmortem needs."""

@@ -84,9 +84,11 @@ def test_predictor_warmup_and_run_batch(saved_model):
     assert out.shape[0] == 11
     np.testing.assert_allclose(out[:8], ref, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(out[8:], ref[:3], rtol=1e-5, atol=1e-6)
-    # steady state: only signatures (4,16) compiled — no per-size compiles
-    sigs = {k[4] for k in pred._exe._cache}
-    assert len(sigs) == 1
+    # steady state: only signature (4,16) compiled — no per-size
+    # compiles. The canonical-fingerprint cache key folds the feed
+    # signature into the entry fingerprint, so one signature (and one
+    # fetch list/scope) means exactly one compiled entry.
+    assert len(pred._exe._cache) == 1
 
 
 @pytest.mark.full
