@@ -18,7 +18,9 @@ import sys
 from bench_common import (
     V5E_PEAK_BF16,
     AllBatchesOOM,
+    attach_metrics,
     compile_with_oom_backoff,
+    enable_bench_metrics,
     log,
     run_windows,
 )
@@ -52,6 +54,9 @@ def analytic_flops_per_step(cfg, batch, s, t):
 
 
 def main():
+    # metrics-only telemetry: the registry snapshot rides every BENCH
+    # row's `metrics` field (PT_BENCH_METRICS=0 opts out)
+    enable_bench_metrics()
     import jax
 
     # Persistent XLA compilation cache: repeat runs (same program/shapes)
@@ -98,8 +103,8 @@ def main():
                                fetch_list=[model["loss"]]),
             BATCH, floor=min(4, BATCH))
     except AllBatchesOOM:
-        print(json.dumps({"metric": "transformer_base_train_tokens_per_sec", "value": 0,
-                          "unit": "tokens/sec", "vs_baseline": 0.0}))
+        print(json.dumps(attach_metrics({"metric": "transformer_base_train_tokens_per_sec", "value": 0,
+                          "unit": "tokens/sec", "vs_baseline": 0.0})))
         return
 
     # steady-state: feeds pre-staged on device, best-of-3 windows with one
@@ -213,7 +218,7 @@ def main():
                 {"PT_BENCH_FAMILY": fam, "PT_BENCH_FAMILIES": "0", **env})
             log(f"{fam}: {families[fam]}")
 
-    print(json.dumps({
+    print(json.dumps(attach_metrics({
         "metric": "transformer_base_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
@@ -230,7 +235,7 @@ def main():
         "deepfm": families.get("deepfm"),
         "ssd300": families.get("ssd300"),
         "warm_start": warm_start,
-    }))
+    })))
 
 
 if __name__ == "__main__":

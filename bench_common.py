@@ -18,12 +18,48 @@ V5E_PEAK_BF16 = 197e12  # FLOP/s per v5e chip
 
 
 def _is_oom(exc) -> bool:
-    msg = f"{type(exc).__name__}: {exc}"
-    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+    from paddle_tpu import monitor
+
+    return monitor.is_oom_error(exc)
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def enable_bench_metrics() -> bool:
+    """Metrics-only telemetry for bench processes (PT_BENCH_METRICS=0
+    opts out): counters/gauges/step records WITHOUT the step_phases
+    plane, whose honest device timing would put a block_until_ready
+    inside every timed window. Counter mutations are lock-guarded dict
+    writes — noise-floor next to a training step."""
+    import os
+
+    if os.environ.get("PT_BENCH_METRICS", "1") != "1":
+        return False
+    from paddle_tpu import flags
+
+    flags.set_flags({"telemetry": True, "step_phases": False})
+    return True
+
+
+def attach_metrics(row: dict) -> dict:
+    """Snapshot the metrics registry into the BENCH row's ``metrics``
+    field so a perf regression is attributable after the fact (cache
+    hit/miss mix, feed bytes, retry counts, ...). Backward-compatible
+    rider: the field is simply absent when telemetry is off, and a
+    snapshot failure never loses the row. Empty instruments are dropped
+    to keep rows readable."""
+    try:
+        from paddle_tpu import monitor
+
+        if monitor.enabled():
+            snap = monitor.snapshot()
+            row["metrics"] = {name: m for name, m in snap.items()
+                              if m["values"]}
+    except Exception as e:
+        log(f"metrics snapshot skipped: {type(e).__name__}: {e}")
+    return row
 
 
 def run_windows(exe, program, loss, feeds, steps=30, n_windows=3,

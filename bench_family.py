@@ -42,7 +42,9 @@ import numpy as np
 from bench_common import (
     V5E_PEAK_BF16,
     AllBatchesOOM,
+    attach_metrics,
     compile_with_oom_backoff,
+    enable_bench_metrics,
     log,
     run_windows,
 )
@@ -94,6 +96,9 @@ def bert_train_flops_per_step(cfg, batch, t) -> float:
 
 
 def main():
+    # metrics-only telemetry: the registry snapshot rides every BENCH
+    # row's `metrics` field (PT_BENCH_METRICS=0 opts out)
+    enable_bench_metrics()
     import jax
 
     jax.config.update("jax_compilation_cache_dir", "/tmp/pt_jax_cache")
@@ -133,8 +138,8 @@ def main():
                 make_exe, lambda e, b: e.run(main_prog, feed=feed(b, 0),
                                              fetch_list=[model["loss"]]), batch)
         except AllBatchesOOM:
-            print(json.dumps({"metric": "se_resnext50_train_images_per_sec", "value": 0,
-                              "unit": "images/sec", "vs_baseline": 0.0}))
+            print(json.dumps(attach_metrics({"metric": "se_resnext50_train_images_per_sec", "value": 0,
+                              "unit": "images/sec", "vs_baseline": 0.0})))
             return
         feeds = [{k: jax.device_put(v) for k, v in feed(batch, s).items()}
                  for s in range(4)]
@@ -145,13 +150,13 @@ def main():
         mfu_mean = ips_mean * train_flops / V5E_PEAK_BF16
         log(f"images/sec={ips:.1f}, train GFLOP/image="
             f"{train_flops / 1e9:.2f}, MFU={mfu:.3f}")
-        print(json.dumps({
+        print(json.dumps(attach_metrics({
             "metric": "se_resnext50_train_images_per_sec",
             "value": round(ips, 1), "unit": "images/sec",
             "vs_baseline": round(mfu / 0.35, 3),
             "value_mean": round(ips_mean, 1),
             "mfu_best": round(mfu, 4), "mfu_mean": round(mfu_mean, 4),
-        }))
+        })))
 
     elif FAMILY == "bert":
         from paddle_tpu.models import bert
@@ -177,9 +182,9 @@ def main():
                                    feed=bert.make_batch(cfg, b, seq, seed=0),
                                    fetch_list=[model["loss"]]), batch)
         except AllBatchesOOM:
-            print(json.dumps({"metric": "bert_base_pretrain_tokens_per_sec",
+            print(json.dumps(attach_metrics({"metric": "bert_base_pretrain_tokens_per_sec",
                               "value": 0, "unit": "tokens/sec",
-                              "vs_baseline": 0.0}))
+                              "vs_baseline": 0.0})))
             return
         feeds = [{k: jax.device_put(v)
                   for k, v in bert.make_batch(cfg, batch, seq, seed=s).items()}
@@ -192,13 +197,13 @@ def main():
         mfu_mean = (flops * steps / mean) / V5E_PEAK_BF16
         log(f"tokens/sec={tps:.0f}, analytic TFLOP/step={flops / 1e12:.2f}, "
             f"MFU={mfu:.3f}")
-        print(json.dumps({
+        print(json.dumps(attach_metrics({
             "metric": "bert_base_pretrain_tokens_per_sec",
             "value": round(tps, 1), "unit": "tokens/sec",
             "vs_baseline": round(mfu / 0.35, 3),
             "value_mean": round(tps_mean, 1),
             "mfu_best": round(mfu, 4), "mfu_mean": round(mfu_mean, 4),
-        }))
+        })))
 
     elif FAMILY == "deepfm":
         from paddle_tpu.models import deepfm
@@ -226,8 +231,8 @@ def main():
                                    fetch_list=[model["loss"]]), batch,
                 floor=256)
         except AllBatchesOOM:
-            print(json.dumps({"metric": "deepfm_train_examples_per_sec",
-                              "value": 0, "unit": "examples/sec"}))
+            print(json.dumps(attach_metrics({"metric": "deepfm_train_examples_per_sec",
+                              "value": 0, "unit": "examples/sec"})))
             return
         feeds = [{k: jax.device_put(v)
                   for k, v in deepfm.make_batch(cfg, batch, seed=s).items()}
@@ -235,11 +240,11 @@ def main():
         best, mean = run_windows(exe, main_prog, model["loss"], feeds, steps)
         eps, eps_mean = batch * steps / best, batch * steps / mean
         log(f"examples/sec={eps:.0f}")
-        print(json.dumps({
+        print(json.dumps(attach_metrics({
             "metric": "deepfm_train_examples_per_sec",
             "value": round(eps, 1), "unit": "examples/sec",
             "value_mean": round(eps_mean, 1),
-        }))
+        })))
 
     elif FAMILY == "ssd300":
         from paddle_tpu.models import ssd
@@ -279,8 +284,8 @@ def main():
                                              fetch_list=[model["loss"]]),
                 batch)
         except AllBatchesOOM:
-            print(json.dumps({"metric": "ssd300_train_images_per_sec",
-                              "value": 0, "unit": "images/sec"}))
+            print(json.dumps(attach_metrics({"metric": "ssd300_train_images_per_sec",
+                              "value": 0, "unit": "images/sec"})))
             return
         feeds = [{k: jax.device_put(v) for k, v in feed(batch, s).items()}
                  for s in range(4)]
@@ -288,11 +293,11 @@ def main():
                                  steps)
         ips, ips_mean = batch * steps / best, batch * steps / mean
         log(f"images/sec={ips:.1f}")
-        print(json.dumps({
+        print(json.dumps(attach_metrics({
             "metric": "ssd300_train_images_per_sec",
             "value": round(ips, 1), "unit": "images/sec",
             "value_mean": round(ips_mean, 1),
-        }))
+        })))
 
     else:
         raise SystemExit(f"unknown PT_BENCH_FAMILY '{FAMILY}'")

@@ -16,7 +16,9 @@ import json
 from bench_common import (
     V5E_PEAK_BF16,
     AllBatchesOOM,
+    attach_metrics,
     compile_with_oom_backoff,
+    enable_bench_metrics,
     log,
     run_windows,
 )
@@ -59,6 +61,9 @@ def resnet50_fwd_flops_per_image() -> float:
 
 
 def main():
+    # metrics-only telemetry: the registry snapshot rides every BENCH
+    # row's `metrics` field (PT_BENCH_METRICS=0 opts out)
+    enable_bench_metrics()
     import jax
 
     # Persistent XLA compilation cache: repeat runs (same program/shapes)
@@ -92,8 +97,8 @@ def main():
                                fetch_list=[model["loss"]]),
             BATCH, floor=8)
     except AllBatchesOOM:
-        print(json.dumps({"metric": "resnet50_train_images_per_sec", "value": 0,
-                          "unit": "images/sec", "vs_baseline": 0.0}))
+        print(json.dumps(attach_metrics({"metric": "resnet50_train_images_per_sec", "value": 0,
+                          "unit": "images/sec", "vs_baseline": 0.0})))
         return
 
     feeds = [
@@ -116,7 +121,7 @@ def main():
     log(f"images/sec={images_per_sec:.1f}, "
         f"train GFLOP/image={train_flops / 1e9:.2f}, MFU={mfu:.3f}")
 
-    print(json.dumps({
+    print(json.dumps(attach_metrics({
         "metric": "resnet50_train_images_per_sec",
         "value": round(images_per_sec, 1),
         "unit": "images/sec",
@@ -124,7 +129,7 @@ def main():
         "value_mean": round(images_per_sec_mean, 1),
         "mfu_best": round(mfu, 4),
         "mfu_mean": round(to_mfu(images_per_sec_mean), 4),
-    }))
+    })))
 
 
 if __name__ == "__main__":

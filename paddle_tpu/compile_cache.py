@@ -91,6 +91,10 @@ _M_LOAD_SECONDS = _monitor.histogram(
     "pt_compile_cache_load_seconds",
     "disk read + executable deserialization time per persistent "
     "compile-cache hit")
+_M_EVICTIONS = _monitor.counter(
+    "pt_compile_cache_evictions_total",
+    "persistent compile-cache entries removed by the size-capped "
+    "LRU-by-mtime disk sweep (compile_cache_max_bytes)")
 
 # Chaos sites (faults.py): load tears the published file BEFORE the read
 # (corruption-regression drills), store tears the staged file before the
@@ -151,7 +155,16 @@ def _sync_dir(v):
         _xla_fallback = None
 
 
+_max_bytes = 0
+
+
+def _sync_max_bytes(v):
+    global _max_bytes
+    _max_bytes = int(v)
+
+
 _flags.watch_flag("compile_cache_dir", _sync_dir)
+_flags.watch_flag("compile_cache_max_bytes", _sync_max_bytes)
 
 
 def active() -> bool:
@@ -463,6 +476,12 @@ def load(spec: Spec):
         dt = time.perf_counter() - t0
         _M_HITS.inc()
         _M_LOAD_SECONDS.observe(dt)
+        try:
+            # LRU touch: the size-capped GC sweep evicts by mtime, so a
+            # hit must refresh it or hot entries age like cold ones
+            os.utime(spec.path)
+        except OSError:
+            pass
         return fn, dt * 1e3
     except Exception as e:
         _M_ERRORS.inc(labels={"stage": "load"})
@@ -502,6 +521,7 @@ def store(spec: Spec, comp) -> bool:
             os.fsync(f.fileno())
         _F_STORE.hit(path=tmp)
         os.replace(tmp, spec.path)
+        gc()  # keep the disk tier inside compile_cache_max_bytes
         return True
     except Exception as e:
         _M_ERRORS.inc(labels={"stage": "store"})
@@ -541,6 +561,72 @@ def aot_build(spec: Spec, jitfn):
     return _wrap(comp, spec.static_steps)
 
 
+# stage-file stragglers older than this are crash leftovers (the
+# publishing process fsync+renames within seconds); the GC sweep
+# reclaims them alongside over-budget entries
+_TMP_REAP_AGE_S = 3600.0
+
+
+def gc(max_bytes: Optional[int] = None) -> int:
+    """Size-capped LRU-by-mtime sweep of the persistent cache dir
+    (closes the 'unbounded today' remainder of the disk tier): evict
+    published ``pcc-*.bin`` entries oldest-mtime-first until the total
+    fits ``max_bytes`` (default: the ``compile_cache_max_bytes`` flag;
+    0 = unbounded, no sweep), always keeping the newest entry even when
+    it alone exceeds the cap (evicting everything would defeat the
+    cache). Loads refresh mtime, so eviction order is least-recently-
+    USED. Also reaps ``.tmp.*`` stage stragglers older than an hour
+    (crashed publishers). Returns entries evicted, metered by
+    ``pt_compile_cache_evictions_total``; any listing/unlink error
+    degrades silently — GC must never fail a store."""
+    cap = _max_bytes if max_bytes is None else int(max_bytes)
+    if not _dir or cap <= 0:
+        return 0
+    evicted = 0
+    try:
+        entries = []
+        now = time.time()
+        with os.scandir(_dir) as it:
+            for de in it:
+                if not de.is_file():
+                    continue
+                if ".tmp." in de.name:
+                    try:
+                        st = de.stat()
+                        if now - st.st_mtime > _TMP_REAP_AGE_S:
+                            os.remove(de.path)
+                    except OSError:
+                        pass
+                    continue
+                if de.name.startswith("pcc-") and de.name.endswith(".bin"):
+                    try:
+                        st = de.stat()
+                    except OSError:
+                        continue
+                    entries.append((st.st_mtime, st.st_size, de.path))
+        total = sum(size for _, size, _ in entries)
+        entries.sort()  # oldest mtime first = coldest first
+        while total > cap and len(entries) > 1:
+            mtime, size, path = entries.pop(0)
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                # a concurrent GC reclaimed it — not evicted by us, but
+                # the space IS gone: without the subtraction this
+                # process over-evicts still-hot entries past the cap
+                total -= size
+                continue
+            except OSError:
+                continue
+            total -= size
+            evicted += 1
+        if evicted:
+            _M_EVICTIONS.inc(evicted)
+    except OSError:
+        pass
+    return evicted
+
+
 def stats() -> Dict[str, Any]:
     """Operator-facing snapshot (debugging, tests)."""
     return {
@@ -549,6 +635,7 @@ def stats() -> Dict[str, Any]:
         "xla_fallback": _xla_fallback,
         "hits": _M_HITS.value(),
         "misses": _M_MISSES.value(),
+        "evictions": _M_EVICTIONS.value(),
         "errors": {stage: _M_ERRORS.value(labels={"stage": stage})
                    for stage in ("spec", "load", "store")},
     }

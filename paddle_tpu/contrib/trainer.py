@@ -469,6 +469,23 @@ class Trainer:
                             f"dispatch {s['dispatch']:.0%}, device "
                             f"{s['device']:.0%} over last {b['steps']} "
                             f"steps)")
+                if _monitor.enabled():
+                    # one fleet-summary line per epoch, rank 0 only
+                    # (fleet_monitor returns None for single-worker
+                    # jobs and non-aggregator ranks); independent of
+                    # log_time_attribution, which silences only the
+                    # attribution line above; never fails an epoch for
+                    # a telemetry hiccup
+                    try:
+                        from paddle_tpu import fleet_monitor as _fm
+
+                        line = _fm.epoch_summary_line()
+                        if line:
+                            print(f"[trainer] epoch {epoch} {line}")
+                    except Exception as e:  # noqa: BLE001
+                        warnings.warn(
+                            f"fleet epoch summary skipped: {e!r}",
+                            RuntimeWarning)
                 if (
                     self._ckpt_cfg is not None
                     and (epoch + 1) % self._ckpt_cfg.epoch_interval == 0

@@ -21,6 +21,7 @@ import numpy as np
 
 from paddle_tpu import analysis as _analysis
 from paddle_tpu import compile_cache as _ccache
+from paddle_tpu import faults as _faults
 from paddle_tpu import monitor as _monitor
 from paddle_tpu import numerics as _numerics
 from paddle_tpu.core import lowering
@@ -56,6 +57,11 @@ _M_FETCH_BYTES = _monitor.counter(
 _M_NAN_FAILS = _monitor.counter(
     "pt_executor_nan_check_failures_total",
     "check_nan_inf scans that found non-finite values")
+
+# chaos hook (faults.py): armed plans can delay the step body (the fleet
+# straggler drill — the sleep lands in the dispatch phase) or raise a
+# synthetic RESOURCE_EXHAUSTED (the OOM-forensics drill)
+_F_STEP = _faults.site("executor.step")
 
 
 def _sum_nbytes(vals) -> int:
@@ -263,9 +269,9 @@ class Executor:
                 program, {k: np.shape(v) for k, v in feed_vals.items()})
         if use_program_cache:
             entry, outcome, evictions, compile_ms = self._cache_entry(
-                key, build, spec_factory)
+                key, build, spec_factory, program)
         else:
-            entry, compile_ms = self._timed_build(build)
+            entry, compile_ms = self._timed_build(build, program)
             outcome, evictions = "miss", 0
         cache_hit = outcome != "miss"
         fn, lowered = entry
@@ -362,10 +368,13 @@ class Executor:
             with _interp.spmd_ctx_scope(strategy), \
                     _monitor.span("executor.run_step"):
                 try:
+                    _F_STEP.hit()
                     fetches, new_state = fn(state, feed_vals, base_key,
                                             np.uint32(step_idx))
-                except Exception:
+                except Exception as e:
                     self._drop_donated(scope, lowered)
+                    _monitor.maybe_record_oom(e, program=program,
+                                              phase="run")
                     raise
             if ph:
                 t_c1 = time.perf_counter()
@@ -374,8 +383,10 @@ class Executor:
                 # _commit — same donated-buffer hygiene as a failed call.
                 try:
                     jax.block_until_ready((fetches, new_state))
-                except Exception:
+                except Exception as e:
                     self._drop_donated(scope, lowered)
+                    _monitor.maybe_record_oom(e, program=program,
+                                              phase="run")
                     raise
                 t_b1 = time.perf_counter()
             bundle = None
@@ -384,8 +395,19 @@ class Executor:
             try:
                 if ph:
                     t_x0 = time.perf_counter()
-                out = self._commit(scope, fetch_names, fetches, new_state,
-                                   return_numpy, rec)
+                try:
+                    out = self._commit(scope, fetch_names, fetches,
+                                       new_state, return_numpy, rec)
+                except Exception as e:
+                    # with step_phases off there is no pre-commit
+                    # block_until_ready: an async-dispatched device
+                    # failure surfaces HERE, in the commit transfer —
+                    # same donated-buffer hygiene + OOM hook as the
+                    # dispatch/device sites above
+                    self._drop_donated(scope, lowered)
+                    _monitor.maybe_record_oom(e, program=program,
+                                              phase="run")
+                    raise
                 if ph:  # only a COMMITTED step gets phase-attributed
                     t_x1 = time.perf_counter()
                 return out
@@ -401,6 +423,11 @@ class Executor:
             # logged even when the step raises (NaN scan, device/runtime
             # error): the crashed step's record is the one an operator
             # needs for postmortem, and must be the last line of the log
+            if tele:
+                # watermarks read AFTER the step (success or failure):
+                # the post-step high-water is the number an OOM
+                # post-mortem wants; self-gating on the sampling period
+                _monitor.sample_device_memory(step_idx)
             if rec is not None:
                 rec["wall_ms"] = (time.perf_counter() - t_run0) * 1e3
                 if t_x1 > 0.0:  # phases only for steps that completed
@@ -560,7 +587,7 @@ class Executor:
                 program,
                 {k: tuple(v.shape[1:]) for k, v in stacked.items()})
         entry, outcome, evictions, compile_ms = self._cache_entry(
-            key, build, spec_factory)
+            key, build, spec_factory, program)
         cache_hit = outcome != "miss"
         fn, lowered = entry
         state = self._gather_state(scope, lowered)
@@ -601,6 +628,7 @@ class Executor:
             first_bad = None
             with _monitor.span("executor.run_window"):
                 try:
+                    _F_STEP.hit()
                     if nan_track:
                         fetches, new_state, first_bad = fn(
                             state, stacked, base_key, np.uint32(start),
@@ -609,15 +637,19 @@ class Executor:
                         fetches, new_state = fn(state, stacked, base_key,
                                                 np.uint32(start),
                                                 int(steps))
-                except Exception:
+                except Exception as e:
                     self._drop_donated(scope, lowered)
+                    _monitor.maybe_record_oom(e, program=program,
+                                              phase="run")
                     raise
             if ph:
                 t_c1 = time.perf_counter()
                 try:
                     jax.block_until_ready((fetches, new_state, first_bad))
-                except Exception:
+                except Exception as e:
                     self._drop_donated(scope, lowered)
+                    _monitor.maybe_record_oom(e, program=program,
+                                              phase="run")
                     raise
                 t_b1 = time.perf_counter()
             bundle = None
@@ -626,10 +658,21 @@ class Executor:
             try:
                 if ph:
                     t_x0 = time.perf_counter()
-                out = self._commit(scope, fetch_names, fetches, new_state,
-                                   return_numpy, rec,
-                                   nan_first_bad=first_bad,
-                                   window=(start, int(steps)))
+                try:
+                    out = self._commit(scope, fetch_names, fetches,
+                                       new_state, return_numpy, rec,
+                                       nan_first_bad=first_bad,
+                                       window=(start, int(steps)))
+                except Exception as e:
+                    # with step_phases off there is no pre-commit
+                    # block_until_ready: an async-dispatched device
+                    # failure surfaces HERE, in the commit transfer —
+                    # same donated-buffer hygiene + OOM hook as the
+                    # dispatch/device sites above
+                    self._drop_donated(scope, lowered)
+                    _monitor.maybe_record_oom(e, program=program,
+                                              phase="run")
+                    raise
                 if ph:  # only a COMMITTED window gets phase-attributed
                     t_x1 = time.perf_counter()
                 return out
@@ -647,6 +690,8 @@ class Executor:
                         rec["numerics"] = summary
         finally:
             # logged even when the window raises (see run())
+            if tele:
+                _monitor.sample_device_memory(start, int(steps))
             if rec is not None:
                 rec["wall_ms"] = (time.perf_counter() - t_run0) * 1e3
                 if t_x1 > 0.0:  # whole-window totals, one verdict entry
@@ -657,7 +702,7 @@ class Executor:
 
     # --- shared plumbing for run()/run_steps() ---
 
-    def _cache_entry(self, key, build, spec_factory=None):
+    def _cache_entry(self, key, build, spec_factory=None, program=None):
         """LRU lookup-or-build with the capacity eviction policy and the
         persistent level-2 tier (compile_cache.py) between them.
 
@@ -699,9 +744,9 @@ class Executor:
                     aot = _ccache.aot_build(spec, fn)
                     return (fn if aot is None else aot), lowered
 
-                entry, compile_ms = self._timed_build(build_aot)
+                entry, compile_ms = self._timed_build(build_aot, program)
             else:
-                entry, compile_ms = self._timed_build(build)
+                entry, compile_ms = self._timed_build(build, program)
         self._cache[key] = entry
         from paddle_tpu import flags as _flags_mod
 
@@ -720,12 +765,19 @@ class Executor:
             _M_CACHE_EVICTIONS.inc(evicted)
         return entry, outcome, evicted, compile_ms
 
-    def _timed_build(self, build):
+    def _timed_build(self, build, program=None):
         """Compile under the unified span; returns ``(entry,
         compile_ms)`` (perf_counter interval) for the step log."""
         with _monitor.span("executor.compile"):
             t0 = time.perf_counter()
-            entry = build()
+            try:
+                entry = build()
+            except Exception as e:
+                # compile-time RESOURCE_EXHAUSTED: the forensics hook's
+                # other half (run-time OOMs are caught at the call sites)
+                _monitor.maybe_record_oom(e, program=program,
+                                          phase="compile")
+                raise
             t1 = time.perf_counter()
             # compiles get their own timeline track: a recompile storm
             # reads as a dense compile row, not as mystery-long steps

@@ -159,6 +159,11 @@ BUILTIN_SITES = {
     "fleet.heartbeat": "worker heartbeat RPC (fleet_base)",
     "fleet.resize": "elastic-resize planning after dead-worker "
                     "detection (fleet_base.plan_resize)",
+    "executor.step": "executor step/window body, pre-dispatch "
+                     "(executor.py; delay = a slowed rank for the fleet "
+                     "straggler drill — the sleep lands in the dispatch "
+                     "phase; raise(RESOURCE_EXHAUSTED ...) = synthetic "
+                     "device OOM for forensics drills)",
     "reader.next": "trainer batch fetch (contrib/trainer.py)",
     "io.export": "inference-model export publish (io.py)",
     "ccache.load": "persistent compile-cache entry read, pre-deserialize "

@@ -103,6 +103,30 @@ _DEFS: Dict[str, tuple] = {
     # derived from (seed, site name), so a seeded chaos run reproduces
     # its fault sequence exactly
     "fault_seed": (int, 0, "seed for probabilistic fault-plan triggers"),
+    # fleet observability plane (fleet_monitor.py): minimum gap between
+    # registry-digest publishes into fleet KV, piggybacked on heartbeat
+    # calls (needs `telemetry` and a multi-worker fleet); 0 = publish on
+    # every heartbeat
+    "fleet_metrics_interval_ms": (int, 1_000,
+                                  "min gap between fleet metric-digest "
+                                  "publishes"),
+    # cross-rank straggler detector (fleet_monitor.py): a rank is named
+    # a straggler when its rolling step time exceeds BOTH the alive-rank
+    # median times this factor AND the median plus the _min_ms floor
+    # (the floor keeps sub-millisecond jitter from naming stragglers on
+    # fast steps)
+    "fleet_straggler_factor": (float, 2.0,
+                               "straggler threshold vs median step time"),
+    "fleet_straggler_min_ms": (int, 20,
+                               "absolute step-time skew floor for the "
+                               "straggler detector"),
+    # device-memory watermarks (monitor.py): sample guarded
+    # Device.memory_stats() into pt_device_bytes_in_use/peak every N
+    # executor steps (CPU/backends without the API degrade silently);
+    # 0 = off. Needs `telemetry`.
+    "device_memory_every_n_steps": (int, 16,
+                                    "device-memory watermark sampling "
+                                    "period"),
     # persistent level-2 compile cache (compile_cache.py): serialized
     # AOT executables resolved from this directory BEFORE tracing, so a
     # fresh process warm-starts a known program in seconds instead of
@@ -111,6 +135,13 @@ _DEFS: Dict[str, tuple] = {
     # persistent compilation cache at <dir>/xla as a fallback tier.
     # Empty = disabled (the executor hot path is one boolean check).
     "compile_cache_dir": (str, "", "persistent compile-cache directory"),
+    # disk budget for compile_cache_dir: after each store the cache runs
+    # a size-capped LRU-by-mtime sweep (loads refresh mtime, so the
+    # least-recently-USED entries go first; evictions metered by
+    # pt_compile_cache_evictions_total); 0 = unbounded
+    "compile_cache_max_bytes": (int, 0,
+                                "disk size cap for the persistent "
+                                "compile cache (LRU-by-mtime sweep)"),
     # pre-compile static program verifier (analysis.py): 'warn' lints
     # every program before its first compile and logs warning/error
     # findings; 'error' additionally raises LintError on error-severity

@@ -32,6 +32,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from paddle_tpu import faults as _faults
+from paddle_tpu import fleet_monitor as _fleet_monitor
 from paddle_tpu import monitor as _monitor
 from paddle_tpu import retry as _retry
 from paddle_tpu.incubate.fleet.role_maker import (
@@ -140,6 +141,10 @@ class Fleet:
                     process_id=self._role.worker_index(),
                 )
             _M_RENDEZVOUS.inc()
+            # register with the fleet observability plane: the /fleet
+            # route aggregates through this client (each worker also
+            # re-attaches on its first digest publish)
+            _fleet_monitor.attach(self)
             atexit.register(self.stop_worker)
         # tag this process's trace exports with its rank so
         # monitor.merge_traces lands each worker's events on its own
@@ -225,6 +230,12 @@ class Fleet:
 
             _retry.call(_once, site="fleet.heartbeat",
                         policy=_HEARTBEAT_POLICY)
+            if _monitor.enabled():
+                # fleet observability: the registry digest rides the
+                # heartbeat cadence (rate-limited inside by the
+                # fleet_metrics_interval_ms flag); with telemetry off
+                # this whole plane costs the one boolean check above
+                _fleet_monitor.maybe_publish(self)
 
     def dead_workers(self, max_age_ms: int = 30_000) -> Sequence[str]:
         if self._client is None:

@@ -74,6 +74,24 @@ Grown in PR 4 with the time-attribution plane:
    ``merge_traces()`` combines fleet-worker files onto per-rank tracks
    with clock-offset alignment.
 
+Grown in PR 9 with the fleet observability plane:
+
+9. **Fleet digests + cluster view** — the schema constants for the
+   cross-rank metric digests workers publish into fleet KV
+   (``FLEET_DIGEST_FIELDS``; assembly/aggregation lives in
+   fleet_monitor.py), the ``/fleet`` cluster-view route and the merged
+   ``/metrics?fleet=1`` Prometheus exposition, plus a ``/`` JSON index
+   of every route.
+
+10. **Device-memory watermarks + OOM forensics** —
+    ``sample_device_memory`` reads guarded ``Device.memory_stats()``
+    into ``pt_device_bytes_in_use/peak{device=}`` gauges every
+    ``device_memory_every_n_steps`` executor steps (CPU / backends
+    without the API degrade silently); ``maybe_record_oom`` turns a
+    RESOURCE_EXHAUSTED failure during compile or run into a forensics
+    report (compile-report peak bytes vs the budget flag, largest live
+    buffers, recent step records) dumped under ``stall_dump_dir``.
+
 Everything is off by default behind typed flags (flags.py); flipping
 ``telemetry`` at runtime takes effect immediately via a flag watcher,
 and every disabled instrument call costs one module-level boolean check.
@@ -413,6 +431,9 @@ def reset():
         _COMPILE_REPORTS.clear()
     _STALLS.clear()
     _stall_seq = 0
+    global _oom_seq
+    _OOM_RECORDS.clear()
+    _oom_seq = 0
     with _TRACE_LOCK:
         _TRACE_RING.clear()
     global _input_wait_s, _last_bound
@@ -422,11 +443,14 @@ def reset():
         _last_bound = None
     import sys
 
-    # numerics rides the same test-isolation hook; lazy so importing
-    # monitor alone never pulls the numerics plane in
+    # numerics and the fleet plane ride the same test-isolation hook;
+    # lazy so importing monitor alone never pulls either in
     numerics = sys.modules.get("paddle_tpu.numerics")
     if numerics is not None:
         numerics.reset()
+    fm = sys.modules.get("paddle_tpu.fleet_monitor")
+    if fm is not None:
+        fm.reset()
 
 
 def snapshot() -> Dict[str, Any]:
@@ -593,27 +617,35 @@ STEP_LOG_FIELDS: Dict[str, tuple] = {
 }
 
 
-def validate_step_record(rec: Dict[str, Any]):
-    """Raise ValueError unless ``rec`` conforms to STEP_LOG_FIELDS."""
+def _validate_fields(rec, fields: Dict[str, tuple], version: int,
+                     kind: str):
+    """Shared field-table validator behind every validate_* entry point
+    (step records, compile reports, fleet digests, OOM reports): dict
+    shape, required fields, per-field types, unknown-field rejection,
+    schema-version match."""
     if not isinstance(rec, dict):
-        raise ValueError(f"step record must be a dict, got {type(rec)}")
-    for field, (types, required, _doc) in STEP_LOG_FIELDS.items():
+        raise ValueError(f"{kind} must be a dict, got {type(rec)}")
+    for field, (types, required, _doc) in fields.items():
         if field not in rec:
             if required:
-                raise ValueError(f"step record missing field '{field}'")
+                raise ValueError(f"{kind} missing field '{field}'")
             continue
         if not isinstance(rec[field], types):
             raise ValueError(
-                f"step record field '{field}' has type "
+                f"{kind} field '{field}' has type "
                 f"{type(rec[field]).__name__}, expected one of "
                 f"{[t.__name__ for t in types]}")
-    unknown = set(rec) - set(STEP_LOG_FIELDS)
+    unknown = set(rec) - set(fields)
     if unknown:
-        raise ValueError(f"step record has unknown fields {sorted(unknown)}")
-    if rec["v"] != STEP_LOG_SCHEMA_VERSION:
-        raise ValueError(
-            f"step record schema v{rec['v']} != "
-            f"v{STEP_LOG_SCHEMA_VERSION}")
+        raise ValueError(f"{kind} has unknown fields {sorted(unknown)}")
+    if rec["v"] != version:
+        raise ValueError(f"{kind} schema v{rec['v']} != v{version}")
+
+
+def validate_step_record(rec: Dict[str, Any]):
+    """Raise ValueError unless ``rec`` conforms to STEP_LOG_FIELDS."""
+    _validate_fields(rec, STEP_LOG_FIELDS, STEP_LOG_SCHEMA_VERSION,
+                     "step record")
 
 
 def step_log_active() -> bool:
@@ -810,26 +842,8 @@ COMPILE_REPORT_FIELDS: Dict[str, tuple] = {
 
 def validate_compile_report(rec: Dict[str, Any]):
     """Raise ValueError unless ``rec`` conforms to COMPILE_REPORT_FIELDS."""
-    if not isinstance(rec, dict):
-        raise ValueError(f"compile report must be a dict, got {type(rec)}")
-    for field, (types, required, _doc) in COMPILE_REPORT_FIELDS.items():
-        if field not in rec:
-            if required:
-                raise ValueError(f"compile report missing field '{field}'")
-            continue
-        if not isinstance(rec[field], types):
-            raise ValueError(
-                f"compile report field '{field}' has type "
-                f"{type(rec[field]).__name__}, expected one of "
-                f"{[t.__name__ for t in types]}")
-    unknown = set(rec) - set(COMPILE_REPORT_FIELDS)
-    if unknown:
-        raise ValueError(
-            f"compile report has unknown fields {sorted(unknown)}")
-    if rec["v"] != COMPILE_REPORT_SCHEMA_VERSION:
-        raise ValueError(
-            f"compile report schema v{rec['v']} != "
-            f"v{COMPILE_REPORT_SCHEMA_VERSION}")
+    _validate_fields(rec, COMPILE_REPORT_FIELDS,
+                     COMPILE_REPORT_SCHEMA_VERSION, "compile report")
     if rec["source"] not in ("xla", "estimate"):
         raise ValueError(
             f"compile report source {rec['source']!r} not in "
@@ -1025,13 +1039,32 @@ _server = None
 _server_thread: Optional[threading.Thread] = None
 _server_started_ts = 0.0
 
+# Route table served by "/" (the JSON index) — one source for the docs
+# and the handler, so a new route cannot silently miss the index.
+ROUTES: Dict[str, str] = {
+    "/": "this JSON index of available routes",
+    "/metrics": "Prometheus text exposition of the metrics registry "
+                "(?fleet=1: merged cross-rank exposition, rank= labels)",
+    "/healthz": "JSON liveness: status, telemetry state, uptime",
+    "/steps": "JSON ring buffer of recent step records (?n= trims)",
+    "/compile": "JSON latest compile report per program",
+    "/numerics": "JSON numerics plane: NaN/Inf provenance + tensor stats",
+    "/lint": "JSON static-verifier plane: latest lint record per program",
+    "/trace": "Chrome-trace JSON timeline (Perfetto-loadable)",
+    "/fleet": "JSON cluster view: per-rank digests, heartbeat ages, "
+              "stragglers, OOM reports",
+}
+
 
 def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
     """Start the observability HTTP server on a background daemon thread
     (idempotent; returns the bound port). ``port=0`` binds an ephemeral
     port — the test / multi-worker-per-host pattern. Routes:
 
-    - ``/metrics``  Prometheus text exposition of the registry
+    - ``/``         JSON index of every route (this table)
+    - ``/metrics``  Prometheus text exposition of the registry;
+      ``?fleet=1`` serves the merged cross-rank exposition instead
+      (every rank's digest samples labelled ``rank=`` — fleet_monitor)
     - ``/healthz``  JSON liveness (status, telemetry state, uptime)
     - ``/steps``    JSON ring buffer of recent step records (``?n=``)
     - ``/compile``  JSON latest compile report per program
@@ -1041,6 +1074,9 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
       program (mode, severity counts, findings — analysis.py)
     - ``/trace``    Chrome-trace JSON of the timeline ring (load it in
       Perfetto / chrome://tracing directly)
+    - ``/fleet``    JSON cluster view: one row per rank (digest + phase
+      breakdown + heartbeat age + dead flag) plus straggler records and
+      OOM reports (fleet_monitor.py)
 
     Binds localhost by default: metrics can carry program names — scrape
     through a sidecar or port-forward, don't expose it."""
@@ -1056,8 +1092,22 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
         def do_GET(self):  # noqa: N802 (http.server API)
             path, _, query = self.path.partition("?")
             try:
-                if path == "/metrics":
-                    body = to_prometheus().encode()
+                if path in ("", "/"):
+                    # JSON index: the zero-knowledge entry point — every
+                    # route with a one-line description (previously 404)
+                    body = json.dumps(
+                        {"routes": ROUTES}, sort_keys=True).encode()
+                    ctype = "application/json"
+                elif path == "/metrics":
+                    if "fleet=1" in query.split("&"):
+                        # merged cross-rank exposition from the latest
+                        # aggregated digests (lazy import:
+                        # fleet_monitor.py imports monitor.py)
+                        from paddle_tpu import fleet_monitor as _fm
+
+                        body = _fm.to_prometheus_fleet().encode()
+                    else:
+                        body = to_prometheus().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif path == "/healthz":
                     body = json.dumps({
@@ -1096,6 +1146,13 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
                     ctype = "application/json"
                 elif path == "/trace":
                     body = json.dumps(trace_snapshot(),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif path == "/fleet":
+                    # lazy import: fleet_monitor.py imports monitor.py
+                    from paddle_tpu import fleet_monitor as _fm
+
+                    body = json.dumps(_fm.cluster_view(), sort_keys=True,
                                       default=str).encode()
                     ctype = "application/json"
                 else:
@@ -1266,13 +1323,22 @@ def _record_stall(site: str, deadline_ms: float, thread_name: str,
             os.makedirs(dump_dir, exist_ok=True)
             path = os.path.join(
                 dump_dir, f"stall-{rec['seq']}-{int(rec['ts'])}.json")
+            dump = {
+                "stall": rec,
+                "steps": recent_steps(),
+                "metrics": snapshot(),
+                "compile_reports": compile_reports(),
+                "oom_reports": oom_records(),
+            }
+            # a multi-host stall is often a straggler: attach the fleet
+            # plane's latest cluster view + straggler records when the
+            # plane is loaded (lazy — fleet_monitor imports monitor)
+            import sys as _sys
+            fm = _sys.modules.get("paddle_tpu.fleet_monitor")
+            if fm is not None:
+                dump["fleet"] = fm.summary()
             with open(path, "w") as f:
-                json.dump({
-                    "stall": rec,
-                    "steps": recent_steps(),
-                    "metrics": snapshot(),
-                    "compile_reports": compile_reports(),
-                }, f, sort_keys=True, indent=1, default=str)
+                json.dump(dump, f, sort_keys=True, indent=1, default=str)
     except Exception as e:
         try:
             warnings.warn(f"stall record dropped: {e!r}", RuntimeWarning)
@@ -1283,6 +1349,304 @@ def _record_stall(site: str, deadline_ms: float, thread_name: str,
 def stalls() -> List[Dict[str, Any]]:
     """Buffered stall records, oldest first."""
     return [dict(r) for r in _STALLS]
+
+
+# ---------------------------------------------------------------------------
+# fleet digest schema (assembly/aggregation: fleet_monitor.py)
+# ---------------------------------------------------------------------------
+
+FLEET_DIGEST_SCHEMA_VERSION = 1
+
+# field name -> (accepted types, required, doc). One digest per worker,
+# published into fleet KV under fleet/metrics/g<gen>/<rank> and
+# aggregated by rank 0 into the /fleet cluster view. Compact on
+# purpose: counters/gauges carry values, histograms only sum/count —
+# full buckets stay on each worker's own /metrics. Bump the version on
+# any incompatible change.
+FLEET_DIGEST_FIELDS: Dict[str, tuple] = {
+    "v": ((int,), True, "schema version (FLEET_DIGEST_SCHEMA_VERSION)"),
+    "ts": ((float, int), True,
+           "wall-clock unix timestamp of the publish (heartbeat-age "
+           "anchor: the aggregator marks a rank dead when now - ts "
+           "exceeds the staleness window)"),
+    "seq": ((int,), True, "per-process publish sequence number"),
+    "rank": ((int,), True, "fleet worker index of the publisher"),
+    "world": ((int,), True, "fleet worker count at publish time"),
+    "gen": ((int,), True, "elastic-resize generation (fleet PT_GEN)"),
+    "host": ((str,), True, "publisher hostname (short form)"),
+    "pid": ((int,), True, "publisher process id"),
+    "counters": ((dict,), True,
+                 "counter name -> [{labels, value}] cells"),
+    "gauges": ((dict,), True, "gauge name -> [{labels, value}] cells"),
+    "hists": ((dict,), True,
+              "histogram name -> [{labels, sum, count}] cells (no "
+              "buckets — the digest stays KV-sized)"),
+    "last_step": ((dict, type(None)), True,
+                  "the publisher's most recent step record "
+                  "(STEP_LOG_FIELDS schema, phases + verdict included) "
+                  "or null before the first step"),
+    "bound": ((dict, type(None)), True,
+              "latest boundedness verdict ({verdict, shares, steps}) "
+              "or null"),
+    "step_wall_ms": ((float, int, type(None)), True,
+                     "median wall_ms over the trailing step-record "
+                     "window — median, so one compile-inflated warmup "
+                     "step cannot skew the straggler detector's "
+                     "per-rank signal"),
+    "phases_ms": ((dict, type(None)), True,
+                  "median per-phase ms over the trailing window (phase "
+                  "-> ms) or null when no attributed steps landed yet"),
+    "steps": ((int,), True,
+              "pt_executor_steps_total at publish time (bounds straggler "
+              "detection latency in steps)"),
+}
+
+
+def validate_fleet_digest(rec: Dict[str, Any]):
+    """Raise ValueError unless ``rec`` conforms to FLEET_DIGEST_FIELDS."""
+    _validate_fields(rec, FLEET_DIGEST_FIELDS,
+                     FLEET_DIGEST_SCHEMA_VERSION, "fleet digest")
+
+
+# Straggler records ({v, ts, rank, phase, step_wall_ms, median_wall_ms,
+# factor, steps, world, deltas_ms}) are produced by fleet_monitor's
+# cross-rank skew detector; the version lives here with the other
+# telemetry schemas (the stall-record precedent: version constant, doc
+# in the producing module).
+STRAGGLER_RECORD_SCHEMA_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# device-memory watermarks
+# ---------------------------------------------------------------------------
+
+_M_DEV_IN_USE = None
+_M_DEV_PEAK = None
+
+
+def _devmem_instruments():
+    global _M_DEV_IN_USE, _M_DEV_PEAK
+    if _M_DEV_IN_USE is None:
+        _M_DEV_IN_USE = gauge(
+            "pt_device_bytes_in_use",
+            "device memory in use at the last sampled step, by device "
+            "(guarded Device.memory_stats(); absent on backends without "
+            "the API)")
+        _M_DEV_PEAK = gauge(
+            "pt_device_bytes_peak",
+            "device-memory high-water mark reported at the last sampled "
+            "step, by device (guarded Device.memory_stats())")
+
+
+# cached hot value of device_memory_every_n_steps (0 = off); sampling
+# additionally needs telemetry on
+_devmem_every = 0
+
+
+def _sync_devmem_every(value):
+    global _devmem_every
+    _devmem_every = int(value)
+
+
+def devmem_active() -> bool:
+    """Whether executors should sample device-memory watermarks."""
+    return _enabled and _devmem_every > 0
+
+
+def device_memory() -> Dict[str, Dict[str, int]]:
+    """Guarded read of every local device's ``memory_stats()``:
+    ``{device: {bytes_in_use, peak_bytes}}``, silently empty on CPU or
+    any backend without the API. Never raises."""
+    out: Dict[str, Dict[str, int]] = {}
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            stats_fn = getattr(d, "memory_stats", None)
+            stats = stats_fn() if stats_fn is not None else None
+            if not stats:
+                continue
+            in_use = stats.get("bytes_in_use")
+            peak = stats.get("peak_bytes_in_use")
+            cell: Dict[str, int] = {}
+            if in_use is not None:
+                cell["bytes_in_use"] = int(in_use)
+            if peak is not None:
+                cell["peak_bytes"] = int(peak)
+            if cell:
+                out[str(d)] = cell
+    except Exception:
+        pass  # watermarks are strictly best-effort
+    return out
+
+
+def sample_device_memory(step: int, steps: int = 1):
+    """Sample device-memory watermarks into the
+    ``pt_device_bytes_in_use/peak{device=}`` gauges when the
+    ``device_memory_every_n_steps`` period has a sample point inside
+    ``[step, step + steps)`` (the trace_step_sampled convention, so
+    run_steps windows sample whenever any inner step would). No-op —
+    one int check — while telemetry is off or the period is 0; degrades
+    silently on backends without ``Device.memory_stats()``."""
+    if not _enabled or _devmem_every <= 0:
+        return
+    if _devmem_every > 1 and (-step) % _devmem_every >= steps:
+        return
+    _devmem_instruments()
+    for dev, cell in device_memory().items():
+        if "bytes_in_use" in cell:
+            _M_DEV_IN_USE.set(cell["bytes_in_use"], labels={"device": dev})
+        if "peak_bytes" in cell:
+            _M_DEV_PEAK.set(cell["peak_bytes"], labels={"device": dev})
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+OOM_REPORT_SCHEMA_VERSION = 1
+
+# field name -> (accepted types, required, doc); the report an operator
+# reads AFTER a device OOM killed the step — what was the high-water
+# estimate, what was the budget, what was live, what were the last steps.
+OOM_REPORT_FIELDS: Dict[str, tuple] = {
+    "v": ((int,), True, "schema version (OOM_REPORT_SCHEMA_VERSION)"),
+    "ts": ((float, int), True, "wall-clock unix timestamp of the OOM"),
+    "seq": ((int,), True, "process-wide OOM report sequence number"),
+    "phase": ((str,), True,
+              "'compile' (OOM while building the executable) or 'run' "
+              "(OOM while executing a step)"),
+    "program": ((str, type(None)), True,
+                "program id ('program<uid>') or null"),
+    "error": ((str,), True, "the failure message (truncated)"),
+    "budget_bytes": ((int,), True,
+                     "the device_memory_budget_bytes flag at OOM time "
+                     "(0 = no budget configured)"),
+    "compile_peak_bytes": ((int, type(None)), True,
+                           "peak-bytes estimate from the program's "
+                           "latest compile report, or null when no "
+                           "report exists"),
+    "device_memory": ((dict,), True,
+                      "per-device {bytes_in_use, peak_bytes} watermarks "
+                      "at OOM time (empty when the API is absent)"),
+    "largest_buffers": ((list,), True,
+                        "largest live device buffers, descending: "
+                        "[{nbytes, shape, dtype}] (best-effort via "
+                        "jax.live_arrays)"),
+    "last_steps": ((list,), True,
+                   "trailing step records from the flight recorder"),
+}
+
+_OOM_RECORDS: collections.deque = collections.deque(maxlen=8)
+_oom_seq = 0
+
+_M_OOM = None
+
+
+def _oom_counter():
+    global _M_OOM
+    if _M_OOM is None:
+        _M_OOM = counter(
+            "pt_oom_events_total",
+            "RESOURCE_EXHAUSTED failures captured by the OOM forensics "
+            "hook, by phase (compile/run)")
+    return _M_OOM
+
+
+def is_oom_error(exc) -> bool:
+    """Whether ``exc`` is a device out-of-memory failure — jax surfaces
+    OOM as XlaRuntimeError text, not a dedicated type, so this is a
+    message heuristic (the single copy: bench_common's OOM backoff
+    delegates here)."""
+    msg = f"{type(exc).__name__}: {exc}"
+    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+
+
+def _largest_live_buffers(n: int = 10) -> List[Dict[str, Any]]:
+    try:
+        import jax
+
+        arrs = []
+        for a in jax.live_arrays():
+            nb = getattr(a, "nbytes", None)
+            if nb is None:
+                continue
+            arrs.append({"nbytes": int(nb),
+                         "shape": tuple(getattr(a, "shape", ())),
+                         "dtype": str(getattr(a, "dtype", "?"))})
+        arrs.sort(key=lambda c: -c["nbytes"])
+        return arrs[:n]
+    except Exception:
+        return []
+
+
+def maybe_record_oom(exc, program=None, phase: str = "run"):
+    """OOM forensics hook: when telemetry is on and ``exc`` is a device
+    OOM, assemble a report (compile-report peak vs the memory-budget
+    flag, largest live buffers, device watermarks, trailing step
+    records), buffer it, count ``pt_oom_events_total{phase=}`` and —
+    when ``stall_dump_dir`` is set — dump it as
+    ``oom-<seq>-<ts>.json``. Never raises and never swallows: callers
+    re-raise the original failure."""
+    global _oom_seq
+    if not _enabled or not is_oom_error(exc):
+        return
+    try:
+        prog = None if program is None else f"program{program._uid}"
+        report = None
+        if prog is not None:
+            report = compile_reports().get(prog)
+        with _LOCK:
+            seq = _oom_seq
+            _oom_seq += 1
+        rec = {
+            "v": OOM_REPORT_SCHEMA_VERSION,
+            "ts": time.time(),
+            "seq": seq,
+            "phase": str(phase),
+            "program": prog,
+            "error": f"{type(exc).__name__}: {exc}"[:2000],
+            "budget_bytes": int(_mem_budget),
+            "compile_peak_bytes": (None if report is None
+                                   else report.get("peak_bytes")),
+            "device_memory": device_memory(),
+            "largest_buffers": _largest_live_buffers(),
+            "last_steps": recent_steps(8),
+        }
+        _OOM_RECORDS.append(rec)
+        _oom_counter().inc(labels={"phase": str(phase)})
+        warnings.warn(
+            f"device OOM during {phase} of {prog or 'a program'}: "
+            f"compile-report peak "
+            f"{rec['compile_peak_bytes'] or 'unknown'} B vs budget "
+            f"{_mem_budget or 'unset'} B — forensics report buffered"
+            + (f" and dumped under "
+               f"{_flags.get_flag('stall_dump_dir')!r}"
+               if _flags.get_flag("stall_dump_dir") else ""),
+            RuntimeWarning)
+        dump_dir = _flags.get_flag("stall_dump_dir")
+        if dump_dir:
+            os.makedirs(dump_dir, exist_ok=True)
+            path = os.path.join(
+                dump_dir, f"oom-{seq}-{int(rec['ts'])}.json")
+            with open(path, "w") as f:
+                json.dump(rec, f, sort_keys=True, indent=1, default=str)
+    except Exception as e:
+        try:
+            warnings.warn(f"OOM report dropped: {e!r}", RuntimeWarning)
+        except Exception:
+            pass
+
+
+def oom_records() -> List[Dict[str, Any]]:
+    """Buffered OOM forensics reports, oldest first."""
+    return [dict(r) for r in _OOM_RECORDS]
+
+
+def validate_oom_report(rec: Dict[str, Any]):
+    """Raise ValueError unless ``rec`` conforms to OOM_REPORT_FIELDS."""
+    _validate_fields(rec, OOM_REPORT_FIELDS,
+                     OOM_REPORT_SCHEMA_VERSION, "OOM report")
 
 
 # ---------------------------------------------------------------------------
@@ -1768,6 +2132,8 @@ _stall_counter()
 _compile_instruments()
 _phase_instruments()
 _trace_instruments()
+_devmem_instruments()
+_oom_counter()
 
 # Route every profiler.record_event host span into the trace ring: the
 # legacy profiler API and the new timeline share one clock and one
@@ -1787,3 +2153,4 @@ _flags.watch_flag("trace_dir", _sync_trace_on)
 _flags.watch_flag("trace_every_n_steps", _sync_trace_every)
 _flags.watch_flag("device_memory_budget_bytes", _sync_mem_budget)
 _flags.watch_flag("stall_timeout_ms", _sync_stall_ms)
+_flags.watch_flag("device_memory_every_n_steps", _sync_devmem_every)

@@ -1,0 +1,140 @@
+"""Worker process for the fleet-observability multi-process tests
+(harness: tests/test_fleet_observability.py).
+
+Each of N workers trains a tiny local model with telemetry on and
+heartbeats after every step; the digest plane piggybacks a registry
+digest into fleet KV on each heartbeat (fleet_metrics_interval_ms=0).
+Rank 0 serves the monitor endpoint (prints ``OBS_PORT <port>``) and
+aggregates the cluster view each step, so the harness can scrape
+``/fleet`` and ``/metrics?fleet=1`` live.
+
+The bootstrap is metrics-only: coord KV + heartbeat WITHOUT
+``jax.distributed`` — the digest plane needs only the coordination
+service, and multiprocess CPU collectives are out of scope for this
+jax (the GSPMD training path has its own parity tests).
+
+Drills, selected by env:
+- ``PT_FLAGS_fault_plan=executor.step:delay(X)@p1.0`` on one rank: the
+  seeded straggler drill (the delay lands in the dispatch phase).
+- ``PT_OBS_DIE_RANK``/``PT_OBS_DIE_STEP``: that rank exits abruptly at
+  that step (no farewell) — the dead-worker drill.
+
+After its steps every surviving worker idles (heartbeat + publish)
+until the harness writes a line to its stdin, so the harness controls
+exactly when digests start aging; rank 0 then prints ``OBS_RESULT``
+with the final view.
+
+Run: PT_TRAINER_ID=<r> PT_TRAINERS=<n> PT_COORD_ENDPOINT=127.0.0.1:<p>
+     python fleet_obs_worker.py
+"""
+
+import json
+import os
+import select
+import sys
+import time
+
+import jax
+
+if __name__ == "__main__":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import fleet_monitor, flags, layers, monitor  # noqa: E402
+from paddle_tpu import native  # noqa: E402
+from paddle_tpu.incubate.fleet import fleet  # noqa: E402
+from paddle_tpu.incubate.fleet.fleet_base import _connect_retry  # noqa: E402
+from paddle_tpu.incubate.fleet.role_maker import EnvRoleMaker  # noqa: E402
+
+DIM, CLS = 8, 4
+STEPS = int(os.environ.get("PT_OBS_STEPS", "30"))
+STEP_SLEEP = float(os.environ.get("PT_OBS_STEP_SLEEP", "0.02"))
+DIE_RANK = int(os.environ.get("PT_OBS_DIE_RANK", "-1"))
+DIE_STEP = int(os.environ.get("PT_OBS_DIE_STEP", "5"))
+
+
+def build():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[DIM], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        logits = layers.fc(x, CLS)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _stdin_has_line() -> bool:
+    r, _, _ = select.select([sys.stdin], [], [], 0)
+    return bool(r)
+
+
+def main():
+    flags.set_flags({"telemetry": True, "fleet_metrics_interval_ms": 0})
+    rank = int(os.environ["PT_TRAINER_ID"])
+    host, port = os.environ["PT_COORD_ENDPOINT"].rsplit(":", 1)
+
+    fleet._role = EnvRoleMaker()
+    if rank == 0:
+        fleet._server = native.CoordServer(int(port))
+    fleet._client = _connect_retry(host, int(port), 60_000)
+    fleet._initialized = True
+    fleet_monitor.attach(fleet)
+
+    main_prog, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+
+    if rank == 0:
+        srv_port = monitor.serve(0)
+        print(f"OBS_PORT {srv_port}", flush=True)
+
+    fleet.barrier("obs/start")
+    # seed KV before the first step: compiles can hold a rank's first
+    # in-loop heartbeat back for seconds, and an aggregation pass in
+    # that window would report the rank missing (or, worse, see a fast
+    # peer's digest age past the staleness floor first)
+    fleet.heartbeat()
+    rng = np.random.RandomState(rank + 1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in range(STEPS):
+            if rank == DIE_RANK and step == DIE_STEP:
+                os._exit(0)  # abrupt death: only the heartbeat age tells
+            x = rng.randn(4, DIM).astype(np.float32)
+            y = rng.randint(0, CLS, (4, 1)).astype(np.int64)
+            exe.run(main_prog, feed={"x": x, "label": y},
+                    fetch_list=[loss])
+            try:
+                fleet.heartbeat()  # piggybacks the digest publish
+                if rank == 0:
+                    fleet_monitor.aggregate(fleet)
+            except OSError:
+                # rank 0 tore the coord server down (the harness reaps
+                # workers in arbitrary order): wind down cleanly
+                break
+            time.sleep(STEP_SLEEP)
+        # idle under harness control: keep heartbeating/publishing (so
+        # live digests stay fresh while the harness scrapes) until a
+        # line arrives on stdin
+        while not _stdin_has_line():
+            try:
+                fleet.heartbeat()
+                if rank == 0:
+                    fleet_monitor.aggregate(fleet)
+            except OSError:
+                break  # rank 0 tore the coord server down: we're done
+            time.sleep(0.05)
+    if rank == 0:
+        view = fleet_monitor.aggregate(fleet)
+        print("OBS_RESULT " + json.dumps(
+            {"view": view,
+             "stragglers": fleet_monitor.straggler_records()},
+            default=str), flush=True)
+    fleet.stop_worker()
+
+
+if __name__ == "__main__":
+    main()

@@ -467,3 +467,139 @@ def test_disabled_path_allocates_nothing_in_compile_cache():
     assert grew < n_runs * 16, (
         f"disabled Executor.run allocated {grew}B in compile_cache.py "
         f"over {n_runs} runs")
+
+
+# --------------------------------------------------------------------------
+# disk GC (ISSUE 9 satellite): size-capped LRU-by-mtime sweep
+# --------------------------------------------------------------------------
+
+def _fake_entry(d, name, nbytes, mtime):
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, name)
+    with open(path, "wb") as f:
+        f.write(b"\0" * nbytes)
+    os.utime(path, (mtime, mtime))
+    return path
+
+
+def _evictions():
+    return monitor.counter("pt_compile_cache_evictions_total").value()
+
+
+def test_gc_sweeps_oldest_entries_to_fit_the_cap():
+    import time as _time
+
+    d = flags.get_flag("compile_cache_dir")
+    now = _time.time()
+    old = _fake_entry(d, "pcc-old.bin", 600, now - 300)
+    mid = _fake_entry(d, "pcc-mid.bin", 600, now - 200)
+    new = _fake_entry(d, "pcc-new.bin", 600, now - 100)
+    # a foreign file and a FRESH .tmp straggler are never GC victims
+    other = _fake_entry(d, "notes.txt", 600, now - 900)
+    staged = _fake_entry(d, "pcc-x.bin.tmp.123", 600, now - 10)
+    assert compile_cache.gc(max_bytes=1300) == 1
+    assert not os.path.exists(old)
+    assert os.path.exists(mid) and os.path.exists(new)
+    assert os.path.exists(other) and os.path.exists(staged)
+    assert _evictions() == 1
+    # an HOUR-old .tmp straggler is a crash leftover: reaped
+    crashed = _fake_entry(d, "pcc-y.bin.tmp.9", 10, now - 7200)
+    compile_cache.gc(max_bytes=1300)
+    assert not os.path.exists(crashed)
+    # the newest entry survives even a cap smaller than itself
+    compile_cache.gc(max_bytes=100)
+    assert os.path.exists(new)
+    assert not os.path.exists(mid)
+    assert _evictions() == 2
+
+
+def test_gc_concurrent_removal_counts_freed_space(monkeypatch):
+    """Two processes sharing the dir both sweep: an entry a concurrent
+    GC already reclaimed (os.remove -> FileNotFoundError) is not OUR
+    eviction, but its space IS freed — without the subtraction this
+    process would keep looping and over-evict still-hot entries that
+    actually fit the budget."""
+    import time as _time
+
+    d = flags.get_flag("compile_cache_dir")
+    now = _time.time()
+    old = _fake_entry(d, "pcc-old.bin", 600, now - 300)
+    mid = _fake_entry(d, "pcc-mid.bin", 600, now - 200)
+    new = _fake_entry(d, "pcc-new.bin", 600, now - 100)
+    real_remove = os.remove
+
+    def _raced(path):
+        # the concurrent sweeper wins the race for the oldest entry
+        if path == old:
+            real_remove(path)
+            raise FileNotFoundError(path)
+        real_remove(path)
+
+    monkeypatch.setattr(os, "remove", _raced)
+    # cap fits two entries: only `old` must go, and it went to the
+    # OTHER process — zero evictions of ours, survivors untouched
+    assert compile_cache.gc(max_bytes=1300) == 0
+    assert os.path.exists(mid) and os.path.exists(new)
+    assert _evictions() == 0
+
+
+def test_gc_disabled_without_cap_and_loads_refresh_mtime():
+    """cap 0 = unbounded (no sweep); a disk HIT refreshes the entry's
+    mtime so eviction order is least-recently-USED, not least-recently-
+    written."""
+    import time as _time
+
+    d = flags.get_flag("compile_cache_dir")
+    _fake_entry(d, "pcc-a.bin", 4096, _time.time() - 500)
+    assert compile_cache.gc() == 0  # flag default: unbounded
+    assert os.path.exists(os.path.join(d, "pcc-a.bin"))
+
+    # real entry, stored then re-resolved by a fresh executor: the hit
+    # must bump its mtime past the fake older entry's
+    main, startup, out = _build(stateless=True)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=_feed(), fetch_list=[out])
+    entries = [p for p in glob.glob(d + "/pcc-*.bin")
+               if "pcc-a.bin" not in p]  # startup + main entries
+    assert entries
+    past = _time.time() - 400
+    for p in entries:
+        os.utime(p, (past, past))
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe2.run(main, feed=_feed(), fetch_list=[out])
+    assert monitor.recent_steps()[-1]["cache"] == "disk"
+    # exactly the re-resolved entry (main's) got its mtime refreshed
+    refreshed = [p for p in entries if os.stat(p).st_mtime > past + 1]
+    assert len(refreshed) == 1
+
+
+def test_store_sweeps_via_the_flag_cap():
+    """A store with compile_cache_max_bytes set runs the sweep
+    inline: pre-seeded cold entries beyond the cap are evicted by the
+    publish itself, and the metric accounts for them."""
+    import time as _time
+
+    d = flags.get_flag("compile_cache_dir")
+    for i in range(3):
+        _fake_entry(d, f"pcc-cold{i}.bin", 50_000,
+                    _time.time() - 1000 - i)
+    flags.set_flags({"compile_cache_max_bytes": 120_000})
+    try:
+        main, startup, out = _build(stateless=True)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            exe.run(main, feed=_feed(), fetch_list=[out])
+    finally:
+        flags.set_flags({"compile_cache_max_bytes": 0})
+    # the published entries fit only after evicting cold ones
+    total = sum(os.path.getsize(p) for p in glob.glob(d + "/pcc-*.bin"))
+    assert total <= 120_000
+    assert _evictions() >= 1
+    # the just-published (newest) entries survived
+    assert glob.glob(d + "/pcc-*.bin")

@@ -70,6 +70,39 @@ def test_healthz_and_404():
     assert ei.value.code == 404
 
 
+def test_root_serves_json_route_index():
+    """`/` (previously a 404) serves a JSON index of every route, and
+    the index cannot silently miss one: it IS the handler's table."""
+    monitor.enable()
+    port = monitor.serve(0)
+    status, ctype, body = _get(port, "/")
+    assert status == 200 and ctype == "application/json"
+    index = json.loads(body)
+    assert index == {"routes": monitor.ROUTES}
+    # every indexed route actually answers (the index is not aspirational)
+    for route in index["routes"]:
+        status, _, _ = _get(port, route)
+        assert status == 200, route
+
+
+def test_fleet_route_serves_local_view_single_process():
+    """/fleet without a multi-worker fleet: the single-rank local view,
+    same shape as the aggregated one."""
+    monitor.enable()
+    port = monitor.serve(0)
+    status, ctype, body = _get(port, "/fleet")
+    assert status == 200 and ctype == "application/json"
+    view = json.loads(body)
+    assert view["world"] == 1 and list(view["ranks"]) == ["0"]
+    assert view["ranks"]["0"]["dead"] is False
+    assert view["stragglers"] == [] and view["oom_reports"] == []
+    # the merged exposition answers too (this rank's samples, rank="0")
+    monitor.counter("t_fleet_local_c", "merged-view counter").inc(2)
+    status, ctype, body = _get(port, "/metrics?fleet=1")
+    assert status == 200 and ctype.startswith("text/plain")
+    assert 't_fleet_local_c{rank="0"} 2.0' in body.decode()
+
+
 def test_lint_endpoint_serves_latest_findings():
     from paddle_tpu import analysis
 

@@ -357,7 +357,7 @@ def test_describe_flags_covers_every_flag_with_docs():
     assert names == sorted(names)
     assert set(names) == set(flags.get_flags())
     for row in table:
-        assert row["type"] in ("bool", "int", "str"), row
+        assert row["type"] in ("bool", "int", "float", "str"), row
         assert isinstance(row["doc"], str) and row["doc"].strip(), (
             f"flag '{row['name']}' has no doc string")
         assert row["value"] == flags.get_flag(row["name"])
