@@ -138,7 +138,7 @@ def main():
         try:
             env = {**os.environ, "PT_BENCH_RESNET": "0",
                    "PT_BENCH_LONGCTX": "0", "PT_BENCH_WARMSTART": "0",
-                   **env_extra}
+                   "PT_BENCH_PIPELINE": "0", **env_extra}
             out = subprocess.run(argv, capture_output=True, text=True,
                                  timeout=900, env=env)
             if out.returncode != 0:
@@ -157,7 +157,8 @@ def main():
                 for k in ("resnet50", "long_context_t1024",
                           "long_context_t4096", "long_context_t8192",
                           "se_resnext50",
-                          "bert_base", "deepfm", "ssd300", "warm_start"):
+                          "bert_base", "deepfm", "ssd300", "warm_start",
+                          "pipeline"):
                     parsed.pop(k, None)
             return parsed
         except Exception as e:  # never let a rider kill the headline
@@ -171,7 +172,9 @@ def main():
     want_longctx = os.environ.get("PT_BENCH_LONGCTX", "1") == "1"
     want_families = os.environ.get("PT_BENCH_FAMILIES", "1") == "1"
     want_warmstart = os.environ.get("PT_BENCH_WARMSTART", "1") == "1"
-    if want_resnet or want_longctx or want_families or want_warmstart:
+    want_pipeline = os.environ.get("PT_BENCH_PIPELINE", "1") == "1"
+    if (want_resnet or want_longctx or want_families or want_warmstart
+            or want_pipeline):
         del feeds
         fluid.executor.global_scope().clear()
         exe.close()
@@ -205,6 +208,14 @@ def main():
         warm_start = _rider(
             [sys.executable, os.path.join(here, "bench_warmstart.py")], {})
         log(f"warm_start: {warm_start}")
+    pipeline_row = None
+    if want_pipeline:
+        # sync vs pipelined trainer steady-state step time + the final
+        # boundedness verdict mix (input/dispatch must be ~zero with
+        # prefetch + sampled phases on)
+        pipeline_row = _rider(
+            [sys.executable, os.path.join(here, "bench_pipeline.py")], {})
+        log(f"pipeline: {pipeline_row}")
     if want_families:
         # remaining BASELINE.md rows, one fresh process per family
         for fam, env in (
@@ -235,6 +246,7 @@ def main():
         "deepfm": families.get("deepfm"),
         "ssd300": families.get("ssd300"),
         "warm_start": warm_start,
+        "pipeline": pipeline_row,
     })))
 
 

@@ -34,20 +34,25 @@ class DataFeeder:
         self.place = place
         self.pad_to = dict(pad_to or {})
 
-    def feed(self, iterable) -> Dict[str, np.ndarray]:
+    def feed(self, iterable,
+             critical_path: bool = True) -> Dict[str, np.ndarray]:
         """iterable: list of samples; each sample is a tuple aligned with
         feed_list. Returns {name: batched ndarray} (+ ``name_len`` for fields
         declared in ``pad_to``).
 
         With telemetry on, the batch-assembly time feeds
-        ``pt_feed_build_seconds`` and the boundedness verdict's input
-        score — batching on the step loop's critical path is
-        input-pipeline time even though nothing 'waits'."""
+        ``pt_feed_build_seconds`` and — on the critical path — the
+        boundedness verdict's input score: batching on the step loop's
+        critical path is input-pipeline time even though nothing
+        'waits'. Pass ``critical_path=False`` from a prefetch worker
+        (overlapped assembly must not fake an input_bound verdict; the
+        consumer's queue wait is the honest signal there)."""
         if not _monitor.enabled():
             return self._feed(iterable)
         t0 = time.perf_counter()
         out = self._feed(iterable)
-        _monitor.feed_build(time.perf_counter() - t0)
+        _monitor.feed_build(time.perf_counter() - t0,
+                            critical_path=critical_path)
         return out
 
     def _feed(self, iterable) -> Dict[str, np.ndarray]:

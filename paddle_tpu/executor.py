@@ -10,6 +10,7 @@ prepared-context cache (reference: executor.py:704).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import time
 
@@ -62,6 +63,90 @@ _M_NAN_FAILS = _monitor.counter(
 # straggler drill — the sleep lands in the dispatch phase) or raise a
 # synthetic RESOURCE_EXHAUSTED (the OOM-forensics drill)
 _F_STEP = _faults.site("executor.step")
+# deferred-fetch materialization (LazyFetches.wait): a raised
+# RESOURCE_EXHAUSTED here drills the async-dispatch error path — the
+# device failure that surfaces only when the fetch lands
+_F_FETCH = _faults.site("executor.fetch")
+
+
+def _stage_feeds(feed_vals):
+    """Host->device staging for the sampled phase path: ``device_put``
+    every non-resident feed so the feed phase measures the real
+    host->device transfer. An all-``jax.Array`` feed dict (a
+    DeviceLoader-prefetched batch) returns the SAME dict with zero
+    ``device_put`` calls — the staging-skip contract the prefetch
+    pipeline relies on (and tests spy on)."""
+    for v in feed_vals.values():
+        if not isinstance(v, jax.Array):
+            break
+    else:
+        return feed_vals
+    return {k: v if isinstance(v, jax.Array) else jax.device_put(v)
+            for k, v in feed_vals.items()}
+
+
+class LazyFetches:
+    """Deferred fetch results (``Executor.run``/``run_steps`` with
+    ``async_fetch=True``): list-like, one element per ``fetch_list``
+    entry, already converted to numpy by the time an element is read.
+
+    Construction issues every device->host copy without blocking
+    (``copy_to_host_async`` — the two-pass idiom proven in
+    parallel/checkpoint.py's async snapshot); the numpy conversion
+    happens on first element access (or an explicit ``wait()``), so
+    step N's fetch materializes under step N+1's host dispatch. A
+    deferred device error surfacing at materialization runs the same
+    donated-buffer hygiene + OOM forensics as the synchronous commit
+    sites, exactly once, then re-raises."""
+
+    __slots__ = ("_arrays", "_values", "_on_error", "_t0")
+
+    def __init__(self, arrays, on_error=None):
+        self._arrays = list(arrays)
+        self._values = None
+        self._on_error = on_error
+        for a in self._arrays:
+            try:
+                a.copy_to_host_async()
+            except AttributeError:
+                pass  # host numpy / older jax: np.asarray below copies
+        self._t0 = time.perf_counter() if _monitor.enabled() else 0.0
+
+    @property
+    def ready(self) -> bool:
+        """Whether the fetches have already materialized to numpy."""
+        return self._values is not None
+
+    def wait(self) -> list:
+        """Materialize every fetch to numpy (idempotent)."""
+        if self._values is None:
+            try:
+                _F_FETCH.hit()
+                self._values = [np.asarray(a) for a in self._arrays]
+            except Exception as e:
+                cb, self._on_error = self._on_error, None
+                if cb is not None:
+                    cb(e)
+                raise
+            self._arrays = None  # release the device buffers
+            self._on_error = None
+            if self._t0:
+                _monitor.fetch_overlap(time.perf_counter() - self._t0)
+        return self._values
+
+    def __len__(self):
+        vals = self._values
+        return len(vals if vals is not None else self._arrays)
+
+    def __getitem__(self, i):
+        return self.wait()[i]
+
+    def __iter__(self):
+        return iter(self.wait())
+
+    def __repr__(self):
+        state = "ready" if self.ready else "pending"
+        return f"LazyFetches({len(self)} fetches, {state})"
 
 
 def _sum_nbytes(vals) -> int:
@@ -151,20 +236,26 @@ def _prng_impl():
 class Executor:
     """Runs programs. ``place`` selects the default JAX device kind."""
 
+    # staged run_steps feed windows kept device-resident across calls;
+    # small on purpose: each entry pins a whole stacked feed window on
+    # device, so the cap is an HBM contract, not a perf knob
+    STAGED_WINDOW_CAPACITY = 4
+
     def __init__(self, place: Optional[Union[CPUPlace, TPUPlace]] = None):
         self.place = place if place is not None else TPUPlace(0)
         self._cache: Dict[tuple, Any] = {}
         self._step = 0
         self._base_keys: Dict[tuple, Any] = {}
-        # single-slot cache of the last run_steps feed staging:
-        # (host array refs — pinned so id identity stays valid, stacked
-        # device arrays)
-        self._latest_stacked: Optional[tuple] = None
-        # the compiled-cache key whose entry last used the staging slot;
-        # evicting that entry also clears the slot (stale staging would
-        # pin whole device-resident feed windows after the compiled
-        # entry is gone)
-        self._latest_stacked_key: Optional[tuple] = None
+        # keyed LRU of run_steps feed stagings: id-tuple of the host
+        # arrays -> {"arrs": pinned host refs (id identity stays valid),
+        # "stacked": device window, "owner": compiled-cache key}.
+        # Replaces the old single-slot cache so alternating feed
+        # rotations (stage window B while window A executes) stop
+        # thrashing the slot. Evicting a compiled entry drops the staged
+        # windows it owns (stale staging would pin device-resident feed
+        # windows after the entry is gone).
+        self._staged: "collections.OrderedDict[tuple, dict]" = (
+            collections.OrderedDict())
 
     # --- public API ---
 
@@ -176,6 +267,7 @@ class Executor:
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
         use_program_cache: bool = True,
+        async_fetch: bool = False,
     ):
         from paddle_tpu.compiler import CompiledProgram
 
@@ -294,27 +386,28 @@ class Executor:
         # feed), device = delta to block_until_ready, fetch =
         # device->host + decode in _commit. Gated separately from
         # `tele`: the device phase costs a per-step sync, and the
-        # step_phases flag lets metrics-only telemetry keep async
-        # dispatch.
+        # step_phases / step_phases_every_n flags let metrics-only (or
+        # merely steady-state) telemetry keep async dispatch — only a
+        # SAMPLED step pays the honest-device-timing block_until_ready.
         ph = tele and _monitor.phases_active()
+        sampled = ph and _monitor.phases_sampled(step_idx)
         t_f0 = t_f1 = t_c1 = t_b1 = t_x0 = t_x1 = 0.0
-        if ph:
+        if sampled:
             t_f0 = time.perf_counter()
         if compiled is not None:
             state, feed_vals = compiled.shard_inputs(state, feed_vals)
-        if ph:
+        if sampled:
             if compiled is None:
                 # stage feeds explicitly so the feed phase measures the
                 # real host->device transfer instead of hiding it inside
                 # the jitted call's dispatch (the transfer happens either
                 # way; committed default-device arrays are what jit would
-                # produce). The compiled path keeps shard_inputs as its
-                # staging step — an extra unsharded device_put would
-                # fight the jit's in_shardings.
-                feed_vals = {
-                    k: v if isinstance(v, jax.Array) else jax.device_put(v)
-                    for k, v in feed_vals.items()
-                }
+                # produce; an already-device-resident feed dict skips
+                # staging entirely — see _stage_feeds). The compiled
+                # path keeps shard_inputs as its staging step — an extra
+                # unsharded device_put would fight the jit's
+                # in_shardings.
+                feed_vals = _stage_feeds(feed_vals)
             jax.block_until_ready(list(feed_vals.values()))
             t_f1 = time.perf_counter()
 
@@ -364,6 +457,11 @@ class Executor:
                     "nan_check": None,
                     "strategy": strat_label,
                 }
+                if ph:
+                    # phase plane on: mark whether THIS step paid the
+                    # honest sync (sampled=False walls are host-only —
+                    # /trace and the fleet digest medians filter on it)
+                    rec["sampled"] = sampled
         try:
             with _interp.spmd_ctx_scope(strategy), \
                     _monitor.span("executor.run_step"):
@@ -376,7 +474,7 @@ class Executor:
                     _monitor.maybe_record_oom(e, program=program,
                                               phase="run")
                     raise
-            if ph:
+            if sampled:
                 t_c1 = time.perf_counter()
                 # device phase: drain the async dispatch queue. A
                 # deferred device error surfaces here instead of inside
@@ -393,13 +491,17 @@ class Executor:
             if nplan is not None:
                 bundle, fetches = fetches[-1], fetches[:-1]
             try:
-                if ph:
+                if sampled:
                     t_x0 = time.perf_counter()
                 try:
-                    out = self._commit(scope, fetch_names, fetches,
-                                       new_state, return_numpy, rec)
+                    out = self._commit(
+                        scope, fetch_names, fetches, new_state,
+                        return_numpy, rec, async_fetch=async_fetch,
+                        error_cb=self._fetch_error_cb(
+                            scope, lowered, program)
+                        if async_fetch else None)
                 except Exception as e:
-                    # with step_phases off there is no pre-commit
+                    # with phases off/unsampled there is no pre-commit
                     # block_until_ready: an async-dispatched device
                     # failure surfaces HERE, in the commit transfer —
                     # same donated-buffer hygiene + OOM hook as the
@@ -408,7 +510,7 @@ class Executor:
                     _monitor.maybe_record_oom(e, program=program,
                                               phase="run")
                     raise
-                if ph:  # only a COMMITTED step gets phase-attributed
+                if sampled:  # only a COMMITTED step is phase-attributed
                     t_x1 = time.perf_counter()
                 return out
             finally:
@@ -433,7 +535,11 @@ class Executor:
                 if t_x1 > 0.0:  # phases only for steps that completed
                     self._attribute_phases(
                         rec, step_idx, t_run0, t_f0, t_f1, t_c1, t_b1,
-                        t_x0, t_x1)
+                        t_x0, t_x1, scored=(outcome == "hit"))
+                elif ph:
+                    # unsampled (or failed) step: its input waits must
+                    # not pile into the next sampled step's verdict
+                    _monitor.discard_input_wait()
                 _monitor.log_step(rec)
 
     def run_steps(
@@ -444,6 +550,7 @@ class Executor:
         fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
+        async_fetch: bool = False,
     ):
         """Run ``steps`` training iterations as ONE compiled XLA program,
         rotating over ``feed_list`` (a list of same-signature feed dicts;
@@ -496,12 +603,17 @@ class Executor:
         # contents still change through a writeable base. Mutable numpy
         # feeds are re-staged every call (same contract as run()); pass
         # jax.Arrays or owning frozen copies to get one-time staging.
+        # The cache is a small keyed LRU (STAGED_WINDOW_CAPACITY), so
+        # alternating rotations stay staged — the next rotation's
+        # device_put overlaps the current window's device work instead
+        # of thrashing a single slot.
         # Phase marks (see run()): the stacking below IS the window's
         # feed phase — device_put of the whole window dominates host
         # cost, and the breakdown must show it.
         ph = tele and _monitor.phases_active()
+        sampled = ph and _monitor.phases_sampled(self._step, int(steps))
         t_f0 = t_f1 = t_c1 = t_b1 = t_x0 = t_x1 = 0.0
-        if ph:
+        if sampled:
             t_f0 = time.perf_counter()
         arrs = [fb[k] for fb in feed_list for k in feed_names]
         cacheable = all(
@@ -511,24 +623,30 @@ class Executor:
             for a in arrs
         )
         stacked = None
-        if cacheable and self._latest_stacked is not None:
-            old_arrs, old_stacked = self._latest_stacked
-            if len(old_arrs) == len(arrs) and all(
-                a is b for a, b in zip(old_arrs, arrs)
-            ):
-                stacked = old_stacked
+        staged_key = tuple(map(id, arrs)) if cacheable else None
+        if staged_key is not None:
+            entry = self._staged.get(staged_key)
+            # the pinned refs keep the id()s valid; the `is` sweep makes
+            # the hit exact even so
+            if entry is not None and len(entry["arrs"]) == len(arrs) \
+                    and all(a is b for a, b in zip(entry["arrs"], arrs)):
+                stacked = entry["stacked"]
+                self._staged.move_to_end(staged_key)
         if stacked is None:
             stacked = {
                 k: jnp.stack([jnp.asarray(fb[k]) for fb in feed_list])
                 for k in feed_names
             }
-            if cacheable:
+            if staged_key is not None:
                 # host array refs pinned inside the entry — id() reuse
                 # after GC could otherwise alias a fresh array to a
-                # stale key. An uncacheable call leaves any existing
-                # entry alone: it can only hit on its own pinned arrs.
-                self._latest_stacked = (arrs, stacked)
-        if ph:
+                # stale key. An uncacheable call leaves existing entries
+                # alone: each can only hit on its own pinned arrs.
+                self._staged[staged_key] = {
+                    "arrs": arrs, "stacked": stacked, "owner": None}
+                while len(self._staged) > self.STAGED_WINDOW_CAPACITY:
+                    self._staged.popitem(last=False)
+        if sampled:
             jax.block_until_ready(list(stacked.values()))
             t_f1 = time.perf_counter()
         sig = tuple(
@@ -549,10 +667,10 @@ class Executor:
             ident, program, feed_sig=sig, fetch_names=run_fetch_names,
             extra=("multi", len(feed_list), bool(nan_track)))
         key = (fp, scope._uid, int(steps))
-        if cacheable and self._latest_stacked is not None:
+        if staged_key is not None and staged_key in self._staged:
             # eviction coupling: remember which compiled entry owns the
             # staged window (see _cache_entry)
-            self._latest_stacked_key = key
+            self._staged[staged_key]["owner"] = key
 
         def build():
             lowered = lowering.lower_block(program, 0, feed_names,
@@ -621,6 +739,8 @@ class Executor:
                     "nan_check": None,
                     "strategy": None,
                 }
+                if ph:
+                    rec["sampled"] = sampled
         # under check_nan_inf the window tracks per-step finiteness
         # IN-GRAPH (track_nonfinite): the compiled loop stays one
         # dispatch, yet a failure names the exact step inside it
@@ -642,7 +762,7 @@ class Executor:
                     _monitor.maybe_record_oom(e, program=program,
                                               phase="run")
                     raise
-            if ph:
+            if sampled:
                 t_c1 = time.perf_counter()
                 try:
                     jax.block_until_ready((fetches, new_state, first_bad))
@@ -656,15 +776,19 @@ class Executor:
             if nplan is not None:
                 bundle, fetches = fetches[-1], fetches[:-1]
             try:
-                if ph:
+                if sampled:
                     t_x0 = time.perf_counter()
                 try:
-                    out = self._commit(scope, fetch_names, fetches,
-                                       new_state, return_numpy, rec,
-                                       nan_first_bad=first_bad,
-                                       window=(start, int(steps)))
+                    out = self._commit(
+                        scope, fetch_names, fetches, new_state,
+                        return_numpy, rec, nan_first_bad=first_bad,
+                        window=(start, int(steps)),
+                        async_fetch=async_fetch,
+                        error_cb=self._fetch_error_cb(
+                            scope, lowered, program)
+                        if async_fetch else None)
                 except Exception as e:
-                    # with step_phases off there is no pre-commit
+                    # with phases off/unsampled there is no pre-commit
                     # block_until_ready: an async-dispatched device
                     # failure surfaces HERE, in the commit transfer —
                     # same donated-buffer hygiene + OOM hook as the
@@ -673,7 +797,7 @@ class Executor:
                     _monitor.maybe_record_oom(e, program=program,
                                               phase="run")
                     raise
-                if ph:  # only a COMMITTED window gets phase-attributed
+                if sampled:  # only a COMMITTED window is attributed
                     t_x1 = time.perf_counter()
                 return out
             finally:
@@ -697,7 +821,11 @@ class Executor:
                 if t_x1 > 0.0:  # whole-window totals, one verdict entry
                     self._attribute_phases(
                         rec, start, t_run0, t_f0, t_f1, t_c1, t_b1,
-                        t_x0, t_x1, steps=int(steps))
+                        t_x0, t_x1, steps=int(steps),
+                        scored=(outcome == "hit"))
+                elif ph:
+                    # unsampled (or failed) window: see run()
+                    _monitor.discard_input_wait()
                 _monitor.log_step(rec)
 
     # --- shared plumbing for run()/run_steps() ---
@@ -755,11 +883,11 @@ class Executor:
         while cap > 0 and len(self._cache) > cap:
             victim = next(iter(self._cache))
             self._cache.pop(victim)
-            if victim == self._latest_stacked_key:
-                # the staged feed window must not outlive its owning
-                # compiled entry (see _latest_stacked_key)
-                self._latest_stacked = None
-                self._latest_stacked_key = None
+            # staged feed windows must not outlive their owning compiled
+            # entry (see _staged)
+            for sk in [k for k, e in self._staged.items()
+                       if e["owner"] == victim]:
+                self._staged.pop(sk)
             evicted += 1
         if evicted:
             _M_CACHE_EVICTIONS.inc(evicted)
@@ -785,13 +913,16 @@ class Executor:
             return entry, (t1 - t0) * 1e3
 
     def _attribute_phases(self, rec, step_idx, t_run0, t_f0, t_f1, t_c1,
-                          t_b1, t_x0, t_x1, steps=1):
+                          t_b1, t_x0, t_x1, steps=1, scored=True):
         """Fold a completed step's perf_counter marks into the phase
         breakdown: ``rec['phases']`` (ms), ``rec['bound']`` (the rolling
         window's boundedness verdict), the ``pt_step_phase_seconds``
         histograms, and — on trace-sampled steps — one timeline event
         per phase segment (dispatch is two segments: host work before
-        feed staging and the jitted call itself)."""
+        feed staging and the jitted call itself). ``scored=False``
+        (fresh compile / disk load): phases are recorded but the step
+        stays out of the verdict window — compile time in the dispatch
+        segment would otherwise pollute the boundedness verdict."""
         feed_s = t_f1 - t_f0
         disp_s = (t_f0 - t_run0) + (t_c1 - t_f1)
         dev_s = t_b1 - t_c1
@@ -799,7 +930,7 @@ class Executor:
         rec["phases"] = {"feed": feed_s * 1e3, "dispatch": disp_s * 1e3,
                          "device": dev_s * 1e3, "fetch": fetch_s * 1e3}
         verdict = _monitor.record_step_phases(feed_s, disp_s, dev_s,
-                                              fetch_s)
+                                              fetch_s, scored=scored)
         if verdict is not None:
             rec["bound"] = verdict
         if _monitor.trace_step_sampled(step_idx, steps):
@@ -842,8 +973,18 @@ class Executor:
                 scope.drop(n)
                 _M_DONATED_DROPS.inc()
 
+    def _fetch_error_cb(self, scope, lowered, program):
+        """Deferred-fetch failure hygiene (LazyFetches): the same
+        donated-buffer drop + OOM forensics the synchronous commit
+        sites run, delayed to materialization time."""
+        def on_error(e):
+            self._drop_donated(scope, lowered)
+            _monitor.maybe_record_oom(e, program=program, phase="fetch")
+        return on_error
+
     def _commit(self, scope, fetch_names, fetches, new_state,
-                return_numpy, rec=None, nan_first_bad=None, window=None):
+                return_numpy, rec=None, nan_first_bad=None, window=None,
+                async_fetch=False, error_cb=None):
         from paddle_tpu import flags as _flags
 
         if _flags.get_flag("benchmark"):
@@ -888,6 +1029,11 @@ class Executor:
                 if rec is not None:
                     rec["nan_check"] = "ok"
         if return_numpy:
+            if async_fetch:
+                # overlapped fetch: the device->host copies are issued
+                # now (copy_to_host_async) but materialize lazily — the
+                # caller reads them after dispatching the next step
+                return LazyFetches(fetches, on_error=error_cb)
             fetches = [np.asarray(x) for x in fetches]
         return fetches
 
@@ -946,8 +1092,7 @@ class Executor:
     def close(self):
         self._cache.clear()
         # staging follows its owning entries out (see _cache_entry)
-        self._latest_stacked = None
-        self._latest_stacked_key = None
+        self._staged.clear()
 
     @staticmethod
     def _check_nan_inf(fetch_names, fetches, new_state):

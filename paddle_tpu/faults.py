@@ -165,6 +165,17 @@ BUILTIN_SITES = {
                      "phase; raise(RESOURCE_EXHAUSTED ...) = synthetic "
                      "device OOM for forensics drills)",
     "reader.next": "trainer batch fetch (contrib/trainer.py)",
+    "pipeline.prefetch": "device-feed prefetch worker, per batch before "
+                         "its device_put (reader/pipeline.py; "
+                         "raise(RESOURCE_EXHAUSTED ...) = infeed OOM "
+                         "drill — surfaces in the consumer with OOM "
+                         "forensics; delay = slow host pipeline driving "
+                         "the input_bound verdict)",
+    "executor.fetch": "deferred-fetch materialization (executor.py "
+                      "LazyFetches.wait; raise(RESOURCE_EXHAUSTED ...) "
+                      "= a device failure surfacing only at the async "
+                      "fetch boundary — must still run donated-buffer "
+                      "hygiene + OOM forensics)",
     "io.export": "inference-model export publish (io.py)",
     "ccache.load": "persistent compile-cache entry read, pre-deserialize "
                    "(compile_cache.load; truncate = corrupt published "

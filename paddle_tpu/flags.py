@@ -69,6 +69,15 @@ _DEFS: Dict[str, tuple] = {
     # wants only cheap counters/step-logs can keep async dispatch
     "step_phases": (bool, True,
                     "measure per-step phases (adds a device sync)"),
+    # sample the phase marks every N executor steps: only sampled steps
+    # pay the honest-device-timing block_until_ready; unsampled steps
+    # dispatch fully async (their records carry sampled=False and no
+    # phases). 1 = every step (the pre-sampling behavior)
+    "step_phases_every_n": (int, 16, "step-phase sampling period"),
+    # device-feed prefetch depth for Trainer.train/test's DeviceLoader:
+    # batch N+1's host->device transfer overlaps batch N's device phase;
+    # 0 = stage feeds synchronously through DataFeeder (the old path)
+    "prefetch_depth": (int, 2, "trainer device-feed prefetch depth"),
     # trace-event timeline (monitor.py): host spans, executor step
     # phases, compiles and stall records buffered as Chrome-trace events
     # and written as trace-<host>-<pid>.json into this directory at

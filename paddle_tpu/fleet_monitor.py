@@ -208,8 +208,12 @@ def registry_digest(rank: int = 0, world: int = 1,
     # trailing window would otherwise skew every rank's signal by ITS
     # compile time, and compile durations vary enough across ranks to
     # fake (or mask) a straggler during the first post-warmup steps
+    # sampled=False records dispatched fully async: their wall_ms is
+    # host-only (no device time) and would drag the median toward zero —
+    # only phase-sampled (or pre-sampling-era) records carry honest walls
     walls = [r["wall_ms"] for r in recs
-             if isinstance(r.get("wall_ms"), (int, float))]
+             if isinstance(r.get("wall_ms"), (int, float))
+             and r.get("sampled") is not False]
     phase_recs = [r["phases"] for r in recs if isinstance(
         r.get("phases"), dict)]
     phases_ms: Optional[Dict[str, float]] = None

@@ -105,6 +105,7 @@ import contextlib
 import io
 import json
 import os
+import queue
 import threading
 import time
 import warnings
@@ -612,6 +613,12 @@ STEP_LOG_FIELDS: Dict[str, tuple] = {
     "bound": ((str,), False,
               "boundedness verdict over the trailing step window: "
               "'input_bound', 'dispatch_bound' or 'device_bound'"),
+    "sampled": ((bool,), False,
+                "whether the step-phase plane sampled this step "
+                "(step_phases_every_n): false = the step dispatched "
+                "fully async, so wall_ms excludes device time and the "
+                "record carries no phases; absent while the phase "
+                "plane is off entirely"),
     "strategy": ((str, type(None)), True,
                  "SPMD strategy id (mesh axes) or null for plain runs"),
 }
@@ -1549,7 +1556,7 @@ def _oom_counter():
         _M_OOM = counter(
             "pt_oom_events_total",
             "RESOURCE_EXHAUSTED failures captured by the OOM forensics "
-            "hook, by phase (compile/run)")
+            "hook, by phase (compile/run/fetch/prefetch)")
     return _M_OOM
 
 
@@ -1670,11 +1677,13 @@ _M_STEP_BOUND = None
 _M_READER_DEPTH = None
 _M_READER_WAIT = None
 _M_FEED_BUILD = None
+_M_PREFETCH_DEPTH = None
+_M_FETCH_OVERLAP = None
 
 
 def _phase_instruments():
     global _M_STEP_PHASE, _M_STEP_BOUND, _M_READER_DEPTH, _M_READER_WAIT
-    global _M_FEED_BUILD
+    global _M_FEED_BUILD, _M_PREFETCH_DEPTH, _M_FETCH_OVERLAP
     if _M_STEP_PHASE is None:
         _M_STEP_PHASE = histogram(
             "pt_step_phase_seconds",
@@ -1701,6 +1710,14 @@ def _phase_instruments():
             "pt_feed_build_seconds",
             "DataFeeder.feed batch-assembly time (host input prep on "
             "the critical path)")
+        _M_PREFETCH_DEPTH = gauge(
+            "pt_prefetch_depth",
+            "configured device-feed prefetch depth of the most recently "
+            "started DeviceLoader iteration")
+        _M_FETCH_OVERLAP = histogram(
+            "pt_fetch_overlap_seconds",
+            "async-fetch overlap window: time between a step's deferred "
+            "device->host fetch being issued and its materialization")
 
 
 # cached hot gate for the executor's phase marks: telemetry on AND the
@@ -1708,12 +1725,29 @@ def _phase_instruments():
 # device phase needs a per-step block_until_ready — honest attribution
 # costs the async-dispatch overlap, and metrics-only users can opt out.
 _phases_on = False
+# cached step_phases_every_n: the sampling period bounding how often a
+# step pays that sync — unsampled steps dispatch fully async
+_phases_every = 16
 
 
 def phases_active() -> bool:
     """Whether executors should measure per-step phases (telemetry on
     and the ``step_phases`` flag set)."""
     return _phases_on
+
+
+def phases_sampled(step: int, steps: int = 1) -> bool:
+    """Whether the phase plane samples ``[step, step + steps)``: phases
+    active AND the ``step_phases_every_n`` period has a sample point
+    inside the interval (same no-aliasing window rule as
+    ``trace_step_sampled``). Only sampled steps pay the per-step
+    ``block_until_ready``; unsampled steps dispatch fully async and log
+    ``sampled: false`` records without phases."""
+    if not _phases_on:
+        return False
+    if _phases_every <= 1:
+        return True
+    return (-step) % _phases_every < steps
 
 
 def _sync_phases_on(_value=None):
@@ -1726,6 +1760,11 @@ def _sync_phases_on(_value=None):
         # step's input score and pin the verdict to input_bound
         with _BOUND_LOCK:
             _input_wait_s = 0.0
+
+
+def _sync_phases_every(value):
+    global _phases_every
+    _phases_every = int(value)
 
 
 # input-wait accumulator: reader consumer waits + feed-build time since
@@ -1749,6 +1788,19 @@ def note_input_wait(seconds: float):
         _input_wait_s += seconds
 
 
+def discard_input_wait():
+    """Drop input waits accumulated since the last drain. Executors
+    call this after an UNSAMPLED step (``step_phases_every_n``): the
+    next sampled step must score only ITS OWN input time — draining a
+    whole sampling period's backlog into one step would inflate the
+    input share by the period length."""
+    global _input_wait_s
+    if not _phases_on:
+        return
+    with _BOUND_LOCK:
+        _input_wait_s = 0.0
+
+
 def reader_wait(site: str, role: str, seconds: float):
     """Record one blocked queue operation from the input pipeline
     (``role``: 'producer' = put blocked on a full queue, 'consumer' =
@@ -1769,19 +1821,39 @@ def reader_depth(site: str, depth: int):
     _M_READER_DEPTH.set(depth, labels={"site": site})
 
 
-def feed_build(seconds: float):
+def feed_build(seconds: float, critical_path: bool = True):
     """Record one DataFeeder.feed batch assembly (host input prep);
-    counts toward the boundedness verdict's input score."""
+    counts toward the boundedness verdict's input score unless
+    ``critical_path=False`` (a prefetch worker building batches off the
+    step loop — overlapped assembly time must not fake an input_bound
+    verdict; the consumer's queue wait is the honest signal there)."""
     if not _enabled:
         return
     _M_FEED_BUILD.observe(seconds)
-    note_input_wait(seconds)
+    if critical_path:
+        note_input_wait(seconds)
+
+
+def prefetch_depth(depth: int):
+    """Gauge the configured depth of a starting DeviceLoader iteration."""
+    if not _enabled:
+        return
+    _M_PREFETCH_DEPTH.set(depth)
+
+
+def fetch_overlap(seconds: float):
+    """Record one async-fetch overlap window: issue -> materialization
+    of a step's deferred device->host fetch."""
+    if not _enabled:
+        return
+    _M_FETCH_OVERLAP.observe(seconds)
 
 
 def timed_put(q, item, site: str):
     """``q.put(item)`` with producer-wait + depth telemetry for queue
     ``site`` (a plain put while telemetry is off) — the one shared
-    instrumentation point for every reader-pipeline queue."""
+    instrumentation point for every reader-pipeline queue
+    (``timed_put_stoppable`` is its stop-aware twin)."""
     if not _enabled:
         q.put(item)
         return
@@ -1789,6 +1861,27 @@ def timed_put(q, item, site: str):
     q.put(item)
     reader_wait(site, "producer", time.perf_counter() - t0)
     reader_depth(site, q.qsize())
+
+
+def timed_put_stoppable(q, item, stop, site: str,
+                        poll_s: float = 0.1) -> bool:
+    """``q.put(item)`` that gives up when ``stop`` is set; returns
+    whether the item was enqueued. The stop-aware variant of
+    ``timed_put`` (same producer-wait + depth telemetry, one
+    instrumentation point) for prefetch workers whose consumer may
+    abandon them — ``poll_s`` bounds how long a blocked put takes to
+    observe the stop request."""
+    t0 = time.perf_counter() if _enabled else 0.0
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=poll_s)
+        except queue.Full:
+            continue
+        if t0:
+            reader_wait(site, "producer", time.perf_counter() - t0)
+            reader_depth(site, q.qsize())
+        return True
+    return False
 
 
 def timed_get(q, site: str):
@@ -1804,12 +1897,20 @@ def timed_get(q, site: str):
 
 
 def record_step_phases(feed_s: float, dispatch_s: float, device_s: float,
-                       fetch_s: float) -> Optional[str]:
+                       fetch_s: float, scored: bool = True
+                       ) -> Optional[str]:
     """Record one step's phase breakdown: observes the
     ``pt_step_phase_seconds`` histograms, drains the input-wait
     accumulator into this step, pushes the scores into the rolling
     verdict window and returns the window's verdict (also counted into
     ``pt_step_bound_total{verdict=}``).
+
+    ``scored=False`` (a fresh-compile / disk-load step): the histograms
+    still observe the honest phase durations, but the step stays OUT of
+    the verdict window — a compile's host time would pollute the
+    dispatch share of the next BOUND_WINDOW sampled steps — and its
+    accumulated input waits are discarded rather than dumped into the
+    next scored step. Returns None for unscored steps.
 
     Verdict scoring: ``input`` = reader consumer waits + feed-build
     time since the last step + the feed phase (host->device staging is
@@ -1823,6 +1924,10 @@ def record_step_phases(feed_s: float, dispatch_s: float, device_s: float,
     _M_STEP_PHASE.observe(dispatch_s, labels={"phase": "dispatch"})
     _M_STEP_PHASE.observe(device_s, labels={"phase": "device"})
     _M_STEP_PHASE.observe(fetch_s, labels={"phase": "fetch"})
+    if not scored:
+        with _BOUND_LOCK:
+            _input_wait_s = 0.0
+        return None
     with _BOUND_LOCK:
         input_s = _input_wait_s + feed_s
         _input_wait_s = 0.0
@@ -2148,6 +2253,7 @@ _flags.watch_flag("telemetry", _maybe_autostart_server)
 _flags.watch_flag("telemetry", _sync_trace_on)
 _flags.watch_flag("telemetry", _sync_phases_on)
 _flags.watch_flag("step_phases", _sync_phases_on)
+_flags.watch_flag("step_phases_every_n", _sync_phases_every)
 _flags.watch_flag("metrics_port", _maybe_autostart_server)
 _flags.watch_flag("trace_dir", _sync_trace_on)
 _flags.watch_flag("trace_every_n_steps", _sync_trace_every)

@@ -7,19 +7,33 @@ lod_tensor_blocking_queue.h). Here a background thread converts numpy
 batches and issues ``jax.device_put`` ahead of consumption so the chip never
 waits on the host (SURVEY.md section 7 hard part: infeed that doesn't starve
 the chip).
+
+Lifecycle: every ``DeviceLoader`` iteration owns a stop event. A consumer
+that stops iterating early (a trainer exception, a plain ``break``) used to
+leave the worker blocked forever on a full queue with up to ``depth``
+device-resident batches pinned; now closing the generator (``GeneratorExit``
+from GC or an explicit ``close()``) sets the stop event, the worker's put
+loop observes it within one poll interval and exits, and the queue is
+drained so nothing stays pinned.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Callable, Iterator, Sequence
+from typing import Callable, Iterator, Optional, Sequence
 
 import jax
 import numpy as np
 
+from paddle_tpu import faults as _faults
 from paddle_tpu import monitor as _monitor
 
+# chaos hook (faults.py): armed plans can fail or delay the prefetch
+# worker's per-batch staging — raise(RESOURCE_EXHAUSTED ...) = infeed
+# OOM drill (surfaces in the consumer with forensics), delay = a slow
+# host pipeline driving the input_bound verdict
+_F_PREFETCH = _faults.site("pipeline.prefetch")
 
 class DeviceLoader:
     """Iterate numpy batches with K-deep device-side prefetch."""
@@ -30,15 +44,40 @@ class DeviceLoader:
         self._names = list(feed_names)
         self._depth = depth
         self._sharding = sharding
+        # latest iteration's (stop event, queue, worker thread) — close()
+        # targets it; a new iteration stops the previous one first, so
+        # re-iterating never leaks the old worker
+        self._active: Optional[tuple] = None
+
+    def close(self):
+        """Stop the active iteration's worker (idempotent): sets the
+        stop event and drains the queue so no device-resident batches
+        stay pinned behind an abandoned consumer."""
+        active, self._active = self._active, None
+        if active is None:
+            return
+        stop, q, _thread = active
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
 
     def __iter__(self):
+        self.close()  # re-iteration must not leak the previous worker
         q: queue.Queue = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
         END = object()
         failure = []
+        _monitor.prefetch_depth(self._depth)
 
         def worker():
             try:
                 for sample in self._reader():
+                    if stop.is_set():
+                        return
+                    _F_PREFETCH.hit()
                     if isinstance(sample, dict):
                         feed = {
                             k: jax.device_put(np.asarray(v), self._sharding)
@@ -49,25 +88,51 @@ class DeviceLoader:
                             k: jax.device_put(np.asarray(v), self._sharding)
                             for k, v in zip(self._names, sample)
                         }
-                    _monitor.timed_put(q, feed, "device_loader")
+                    if not _monitor.timed_put_stoppable(
+                            q, feed, stop, "device_loader"):
+                        return
             except BaseException as e:  # surface in the consumer, not the
                 failure.append(e)       # daemon thread's stderr
             finally:
-                q.put(END)
+                _monitor.timed_put_stoppable(q, END, stop,
+                                             "device_loader")
 
-        threading.Thread(target=worker, daemon=True).start()
-        while True:
-            # the consumer wait is THE input-bound signal: an empty
-            # prefetch queue means the step loop outran the host
-            # pipeline, and this wait weighs into the boundedness verdict
-            item = _monitor.timed_get(q, "device_loader")
-            if item is END:
-                if failure:
-                    raise RuntimeError(
-                        "DeviceLoader reader thread failed"
-                    ) from failure[0]
-                return
-            yield item
+        thread = threading.Thread(target=worker, daemon=True,
+                                  name="pt-device-loader")
+        self._active = (stop, q, thread)
+        thread.start()
+
+        def gen():
+            try:
+                while True:
+                    # the consumer wait is THE input-bound signal: an
+                    # empty prefetch queue means the step loop outran the
+                    # host pipeline, and this wait weighs into the
+                    # boundedness verdict
+                    item = _monitor.timed_get(q, "device_loader")
+                    if item is END:
+                        if failure:
+                            exc = failure[0]
+                            # an OOM in the prefetch worker (device_put
+                            # of a batch) gets the same forensics as an
+                            # executor-side OOM, attributed to the
+                            # prefetch phase
+                            _monitor.maybe_record_oom(exc,
+                                                      phase="prefetch")
+                            raise RuntimeError(
+                                "DeviceLoader reader thread failed: "
+                                f"{type(exc).__name__}: {exc}") from exc
+                        return
+                    yield item
+            finally:
+                # GeneratorExit (abandoned consumer) and normal
+                # exhaustion both release the worker + pinned batches
+                if self._active is not None and self._active[0] is stop:
+                    self.close()
+                else:
+                    stop.set()
+
+        return gen()
 
 
 class PyReader:
@@ -81,6 +146,7 @@ class PyReader:
         self._capacity = capacity
         self._batch_reader = None
         self._places = None
+        self._loader: Optional[DeviceLoader] = None
 
     def decorate_sample_list_generator(self, reader, places=None):
         self._batch_reader = reader
@@ -93,16 +159,33 @@ class PyReader:
     def __iter__(self):
         from paddle_tpu.data_feeder import DataFeeder
 
+        # a previous iteration's worker must not leak: stop it before
+        # starting the next (DeviceLoader.__iter__ also closes its own
+        # prior iteration, but self._loader may be a different instance)
+        self.reset()
         feeder = DataFeeder(self._feed_vars, place=self._places)
-        loader = DeviceLoader(
-            lambda: (feeder.feed(b) for b in self._batch_reader()),
+        self._loader = DeviceLoader(
+            # assembly runs in the prefetch worker, OFF the verdict's
+            # critical path — overlapped batch building must not count
+            # into the input score (the consumer's queue wait does)
+            lambda: (feeder.feed(b, critical_path=False)
+                     for b in self._batch_reader()),
             [v.name for v in self._feed_vars],
             depth=self._capacity,
         )
-        return iter(loader)
+        return iter(self._loader)
 
     def start(self):
-        pass
+        """The reference's explicit queue start: iteration starts the
+        worker lazily here, so this only validates state."""
+        if self._batch_reader is None:
+            raise RuntimeError(
+                "PyReader.start() before decorate_sample_list_generator/"
+                "decorate_batch_generator — no reader to start")
 
     def reset(self):
-        pass
+        """Stop the active iteration's prefetch worker (the reference's
+        queue reset). Safe to call with no iteration active."""
+        loader, self._loader = self._loader, None
+        if loader is not None:
+            loader.close()

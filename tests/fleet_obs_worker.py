@@ -72,7 +72,10 @@ def _stdin_has_line() -> bool:
 
 
 def main():
-    flags.set_flags({"telemetry": True, "fleet_metrics_interval_ms": 0})
+    # step_phases_every_n=1: the straggler drill needs per-step honest
+    # walls + phases in every digest window (sampled-phases contract)
+    flags.set_flags({"telemetry": True, "fleet_metrics_interval_ms": 0,
+                     "step_phases_every_n": 1})
     rank = int(os.environ["PT_TRAINER_ID"])
     host, port = os.environ["PT_COORD_ENDPOINT"].rsplit(":", 1)
 

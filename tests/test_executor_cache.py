@@ -88,12 +88,12 @@ def test_capacity_eviction_fires_and_is_counted():
         assert len(exe._cache) == 1
 
 
-def test_capacity_eviction_clears_owned_feed_staging_slot():
-    """Evicting a run_steps entry at capacity also drops the single-slot
-    feed-staging cache it owns — stale staging would pin whole
-    device-resident feed windows after the compiled entry is gone (and
-    could never hit again without its entry). A victim that is NOT the
-    owner leaves the staging alone."""
+def test_capacity_eviction_clears_owned_feed_staging_entries():
+    """Evicting a run_steps entry at capacity also drops the staged
+    feed windows it owns in the keyed LRU — stale staging would pin
+    whole device-resident feed windows after the compiled entry is gone
+    (and could never hit again without its entry). A victim that is NOT
+    an owner leaves other stagings alone."""
     main, startup, loss = _build()
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
@@ -103,27 +103,59 @@ def test_capacity_eviction_clears_owned_feed_staging_slot():
         exe.run(startup)
         exe.run_steps(main, feed_list=[{"x": frozen}], steps=2,
                       fetch_list=[loss])
-        assert exe._latest_stacked is not None
-        assert exe._latest_stacked_key is not None
+        assert len(exe._staged) == 1
+        assert next(iter(exe._staged.values()))["owner"] is not None
         # shrink to capacity 1; the next insert (a fresh run signature)
         # evicts both older entries, including the staging owner — the
         # staged window must go with it
         flags.set_flags({"executor_cache_capacity": 1})
         exe.run(main, feed=_feed(), fetch_list=[loss])
-        assert exe._latest_stacked is None
-        assert exe._latest_stacked_key is None
+        assert len(exe._staged) == 0
         # at capacity 2 with the window entry RECENT, evicting the
         # older run() entry does not touch the window's staging
         flags.set_flags({"executor_cache_capacity": 2})
         exe.run_steps(main, feed_list=[{"x": frozen}], steps=2,
                       fetch_list=[loss])  # cache: {run, window}
-        assert exe._latest_stacked is not None
+        assert len(exe._staged) == 1
         exe.run(main, feed=_feed(), fetch_list=[])  # evicts the run entry
-        assert exe._latest_stacked is not None
+        assert len(exe._staged) == 1
         assert len(exe._cache) == 2
         exe.close()  # close drops staging with the entries
-        assert exe._latest_stacked is None
-        assert exe._latest_stacked_key is None
+        assert len(exe._staged) == 0
+
+
+def test_staged_window_lru_keeps_alternating_rotations():
+    """The keyed staging LRU holds several feed rotations at once:
+    alternating windows A/B/A/B must both stay staged (the old
+    single-slot cache thrashed on exactly this pattern), and the LRU
+    cap bounds how many device-resident windows can accumulate."""
+    main, startup, loss = _build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    def frozen(seed):
+        a = np.random.RandomState(seed).randn(4, 8).astype(np.float32)
+        a.flags.writeable = False
+        return a
+
+    wa, wb = {"x": frozen(0)}, {"x": frozen(1)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run_steps(main, feed_list=[wa], steps=1, fetch_list=[loss])
+        exe.run_steps(main, feed_list=[wb], steps=1, fetch_list=[loss])
+        assert len(exe._staged) == 2
+        staged_a = [e["stacked"]["x"] for e in exe._staged.values()]
+        # both rotations hit their staged windows on the second pass
+        exe.run_steps(main, feed_list=[wa], steps=1, fetch_list=[loss])
+        exe.run_steps(main, feed_list=[wb], steps=1, fetch_list=[loss])
+        assert [e["stacked"]["x"] for e in exe._staged.values()] \
+            == staged_a
+        # the cap bounds device pinning: distinct rotations beyond
+        # capacity evict the coldest
+        for seed in range(2, 2 + exe.STAGED_WINDOW_CAPACITY):
+            exe.run_steps(main, feed_list=[{"x": frozen(seed)}], steps=1,
+                          fetch_list=[loss])
+        assert len(exe._staged) == exe.STAGED_WINDOW_CAPACITY
 
 
 def test_failing_step_still_logs_a_record(tmp_path):

@@ -39,7 +39,8 @@ def _clean_telemetry():
                      "fleet_metrics_interval_ms": 1000,
                      "fleet_straggler_factor": 2.0,
                      "fleet_straggler_min_ms": 20,
-                     "device_memory_every_n_steps": 16})
+                     "device_memory_every_n_steps": 16,
+                     "step_phases_every_n": 1})
     yield
     monitor.stop_server()
     monitor.reset()
@@ -50,7 +51,8 @@ def _clean_telemetry():
                      "fleet_metrics_interval_ms": 1000,
                      "fleet_straggler_factor": 2.0,
                      "fleet_straggler_min_ms": 20,
-                     "device_memory_every_n_steps": 16})
+                     "device_memory_every_n_steps": 16,
+                     "step_phases_every_n": 1})
 
 
 # --------------------------------------------------------------------------

@@ -306,6 +306,11 @@ def test_validate_step_record_rejects_bad_records():
         monitor.validate_step_record(dict(good, phases=[0.1, 0.2]))
     with pytest.raises(ValueError, match="type"):
         monitor.validate_step_record(dict(good, bound=3))
+    # PR-10 optional field: the sampled marker (async-dispatch plane)
+    monitor.validate_step_record(dict(good, sampled=False))
+    monitor.validate_step_record(dict(good, sampled=True))
+    with pytest.raises(ValueError, match="type"):
+        monitor.validate_step_record(dict(good, sampled="no"))
 
 
 def test_log_step_unwritable_path_warns_once_never_raises(tmp_path):
@@ -379,6 +384,12 @@ def test_describe_flags_covers_every_flag_with_docs():
     assert by_name["trace_dir"]["default"] == ""
     assert by_name["trace_every_n_steps"]["type"] == "int"
     assert by_name["trace_every_n_steps"]["default"] == 1
+    # the async-dispatch plane's flags: phases sampled every 16 steps,
+    # trainer prefetch two batches deep
+    assert by_name["step_phases_every_n"]["type"] == "int"
+    assert by_name["step_phases_every_n"]["default"] == 16
+    assert by_name["prefetch_depth"]["type"] == "int"
+    assert by_name["prefetch_depth"]["default"] == 2
 
 
 def test_watch_flag_fires_immediately_and_on_change():

@@ -93,7 +93,7 @@ def test_run_steps_sees_in_place_feed_mutation():
     view = buf.view()
     view.flags.writeable = False
     exe.run_steps(main, feed_list=[{"x": view}], steps=1, fetch_list=[s])
-    assert exe._latest_stacked is None
+    assert len(exe._staged) == 0
     buf[...] = 3.0  # mutation through the base reaches the frozen view
     out = exe.run_steps(main, feed_list=[{"x": view}], steps=1,
                         fetch_list=[s])
@@ -102,11 +102,11 @@ def test_run_steps_sees_in_place_feed_mutation():
     frozen = buf.copy()
     frozen.flags.writeable = False
     exe.run_steps(main, feed_list=[{"x": frozen}], steps=1, fetch_list=[s])
-    cached = exe._latest_stacked[1]["x"]
+    cached = next(iter(exe._staged.values()))["stacked"]["x"]
     # an interleaved mutable-feed call must not wipe the frozen entry
     exe.run_steps(main, feed_list=[{"x": buf}], steps=1, fetch_list=[s])
     exe.run_steps(main, feed_list=[{"x": frozen}], steps=1, fetch_list=[s])
-    assert exe._latest_stacked[1]["x"] is cached
+    assert next(iter(exe._staged.values()))["stacked"]["x"] is cached
 
 
 def test_run_steps_continues_the_step_counter():
