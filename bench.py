@@ -16,12 +16,13 @@ import json
 import sys
 
 from bench_common import (
-    V5E_PEAK_BF16,
     AllBatchesOOM,
     attach_metrics,
     compile_with_oom_backoff,
     enable_bench_metrics,
     log,
+    measured_mfu,
+    mfu,
     run_windows,
 )
 
@@ -123,9 +124,14 @@ def main():
     tokens_per_step = batch * SEQ  # target tokens (reference convention)
     tokens_per_sec = tokens_per_step * steps / best
     flops = analytic_flops_per_step(cfg, batch, SEQ, SEQ)
-    mfu = (flops * steps / best) / V5E_PEAK_BF16
-    mfu_mean = (flops * steps / mean) / V5E_PEAK_BF16
-    log(f"tokens/sec={tokens_per_sec:.0f}, analytic TFLOP/step={flops/1e12:.2f}, MFU={mfu:.3f}")
+    mfu_best = mfu(flops, steps, best)
+    mfu_mean = mfu(flops, steps, mean)
+    # measured twin (roofline.py): XLA cost-analysis flops from the
+    # compile report over the same best window — null when telemetry or
+    # the report is off
+    mfu_measured = measured_mfu(main_prog, best, steps)
+    log(f"tokens/sec={tokens_per_sec:.0f}, analytic TFLOP/step={flops/1e12:.2f}, "
+        f"MFU={mfu_best:.3f}, measured MFU={mfu_measured}")
 
     # Secondary metrics ride along in FRESH processes: two co-resident
     # compiled programs contaminate each other's HBM/timing (see
@@ -233,10 +239,11 @@ def main():
         "metric": "transformer_base_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
-        "vs_baseline": round(mfu / 0.35, 3),
+        "vs_baseline": round(mfu_best / 0.35, 3),
         "value_mean": round(tokens_per_step * steps / mean, 1),
-        "mfu_best": round(mfu, 4),
+        "mfu_best": round(mfu_best, 4),
         "mfu_mean": round(mfu_mean, 4),
+        "measured_mfu": mfu_measured,
         "resnet50": resnet,
         "long_context_t1024": longctx,
         "long_context_t4096": longctx4k,

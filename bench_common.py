@@ -14,7 +14,40 @@ import time
 
 import numpy as np
 
-V5E_PEAK_BF16 = 197e12  # FLOP/s per v5e chip
+# THE peak the analytic-MFU rows divide by — defined once, in the
+# roofline plane (its TPU backend-peaks entry), re-exported here so
+# every bench_* script keeps importing it from bench_common.
+from paddle_tpu.roofline import V5E_PEAK_BF16  # noqa: F401
+
+
+def mfu(flops_per_step: float, steps: int, seconds: float) -> float:
+    """Analytic model-FLOPs utilization: the ONE copy of the arithmetic
+    every bench row used to hand-roll (bench.py, bench_family.py x2,
+    bench_resnet.py) — ``flops_per_step * steps / seconds`` achieved
+    FLOP/s over the v5e bf16 peak."""
+    return (float(flops_per_step) * steps / seconds) / V5E_PEAK_BF16
+
+
+def measured_mfu(program, window_seconds: float, steps: int):
+    """MEASURED MFU for a bench row, from the roofline plane: builds an
+    estimate-source device profile (XLA cost-analysis flops from the
+    program's compile report over the measured window seconds) and
+    returns its ``measured_mfu`` — None when telemetry is off or no
+    compile report carries flops (the row's field is then null, same
+    backward-compatible rider contract as ``metrics``)."""
+    try:
+        from paddle_tpu import monitor, roofline
+
+        if not monitor.enabled():
+            return None
+        prof = roofline.estimate_profile(
+            program, device_seconds=float(window_seconds),
+            steps=int(steps))
+        v = prof.get("measured_mfu")
+        return None if v is None else round(v, 4)
+    except Exception as e:
+        log(f"measured-MFU profile skipped: {type(e).__name__}: {e}")
+        return None
 
 
 def _is_oom(exc) -> bool:
@@ -32,14 +65,34 @@ def enable_bench_metrics() -> bool:
     opts out): counters/gauges/step records WITHOUT the step_phases
     plane, whose honest device timing would put a block_until_ready
     inside every timed window. Counter mutations are lock-guarded dict
-    writes — noise-floor next to a training step."""
+    writes — noise-floor next to a training step.
+
+    Also points ``compile_report_dir`` at a scratch dir so every fresh
+    compile records its XLA cost analysis — the flops source for the
+    rows' ``measured_mfu`` field. The report's extra AOT compile lands
+    at warmup (cache misses), never inside a timed window;
+    PT_BENCH_PROFILE=0 opts out of just this half."""
     import os
 
     if os.environ.get("PT_BENCH_METRICS", "1") != "1":
         return False
     from paddle_tpu import flags
 
-    flags.set_flags({"telemetry": True, "step_phases": False})
+    new = {"telemetry": True, "step_phases": False}
+    if (os.environ.get("PT_BENCH_PROFILE", "1") == "1"
+            and not flags.get_flag("compile_report_dir")):
+        # a user-configured report dir (PT_FLAGS_compile_report_dir)
+        # wins — only an UNSET flag gets the self-reaping scratch dir
+        import atexit
+        import shutil
+        import tempfile
+
+        d = tempfile.mkdtemp(prefix="pt_bench_cr_")
+        # scratch dir, one per bench process: reap it at exit or a
+        # bench.py invocation (~9 fresh subprocesses) leaks 9 of them
+        atexit.register(shutil.rmtree, d, ignore_errors=True)
+        new["compile_report_dir"] = d
+    flags.set_flags(new)
     return True
 
 

@@ -40,12 +40,13 @@ import os
 import numpy as np
 
 from bench_common import (
-    V5E_PEAK_BF16,
     AllBatchesOOM,
     attach_metrics,
     compile_with_oom_backoff,
     enable_bench_metrics,
     log,
+    measured_mfu,
+    mfu,
     run_windows,
 )
 
@@ -146,16 +147,17 @@ def main():
         best, mean = run_windows(exe, main_prog, model["loss"], feeds, steps)
         ips, ips_mean = batch * steps / best, batch * steps / mean
         train_flops = 3.0 * se_resnext50_fwd_flops_per_image()
-        mfu = ips * train_flops / V5E_PEAK_BF16
-        mfu_mean = ips_mean * train_flops / V5E_PEAK_BF16
+        mfu_best = mfu(batch * train_flops, steps, best)
+        mfu_mean = mfu(batch * train_flops, steps, mean)
         log(f"images/sec={ips:.1f}, train GFLOP/image="
-            f"{train_flops / 1e9:.2f}, MFU={mfu:.3f}")
+            f"{train_flops / 1e9:.2f}, MFU={mfu_best:.3f}")
         print(json.dumps(attach_metrics({
             "metric": "se_resnext50_train_images_per_sec",
             "value": round(ips, 1), "unit": "images/sec",
-            "vs_baseline": round(mfu / 0.35, 3),
+            "vs_baseline": round(mfu_best / 0.35, 3),
             "value_mean": round(ips_mean, 1),
-            "mfu_best": round(mfu, 4), "mfu_mean": round(mfu_mean, 4),
+            "mfu_best": round(mfu_best, 4), "mfu_mean": round(mfu_mean, 4),
+            "measured_mfu": measured_mfu(main_prog, best, steps),
         })))
 
     elif FAMILY == "bert":
@@ -193,16 +195,17 @@ def main():
         tps, tps_mean = (batch * seq * steps / best,
                          batch * seq * steps / mean)
         flops = bert_train_flops_per_step(cfg, batch, seq)
-        mfu = (flops * steps / best) / V5E_PEAK_BF16
-        mfu_mean = (flops * steps / mean) / V5E_PEAK_BF16
+        mfu_best = mfu(flops, steps, best)
+        mfu_mean = mfu(flops, steps, mean)
         log(f"tokens/sec={tps:.0f}, analytic TFLOP/step={flops / 1e12:.2f}, "
-            f"MFU={mfu:.3f}")
+            f"MFU={mfu_best:.3f}")
         print(json.dumps(attach_metrics({
             "metric": "bert_base_pretrain_tokens_per_sec",
             "value": round(tps, 1), "unit": "tokens/sec",
-            "vs_baseline": round(mfu / 0.35, 3),
+            "vs_baseline": round(mfu_best / 0.35, 3),
             "value_mean": round(tps_mean, 1),
-            "mfu_best": round(mfu, 4), "mfu_mean": round(mfu_mean, 4),
+            "mfu_best": round(mfu_best, 4), "mfu_mean": round(mfu_mean, 4),
+            "measured_mfu": measured_mfu(main_prog, best, steps),
         })))
 
     elif FAMILY == "deepfm":

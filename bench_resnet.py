@@ -14,12 +14,13 @@ from __future__ import annotations
 import json
 
 from bench_common import (
-    V5E_PEAK_BF16,
     AllBatchesOOM,
     attach_metrics,
     compile_with_oom_backoff,
     enable_bench_metrics,
     log,
+    measured_mfu,
+    mfu,
     run_windows,
 )
 
@@ -114,21 +115,19 @@ def main():
     images_per_sec_mean = batch * steps / mean
     train_flops = 3.0 * resnet50_fwd_flops_per_image()  # bwd ~= 2x fwd
 
-    def to_mfu(ips):
-        return ips * train_flops / V5E_PEAK_BF16
-
-    mfu = to_mfu(images_per_sec)
+    mfu_best = mfu(batch * train_flops, steps, best)
     log(f"images/sec={images_per_sec:.1f}, "
-        f"train GFLOP/image={train_flops / 1e9:.2f}, MFU={mfu:.3f}")
+        f"train GFLOP/image={train_flops / 1e9:.2f}, MFU={mfu_best:.3f}")
 
     print(json.dumps(attach_metrics({
         "metric": "resnet50_train_images_per_sec",
         "value": round(images_per_sec, 1),
         "unit": "images/sec",
-        "vs_baseline": round(mfu / 0.35, 3),
+        "vs_baseline": round(mfu_best / 0.35, 3),
         "value_mean": round(images_per_sec_mean, 1),
-        "mfu_best": round(mfu, 4),
-        "mfu_mean": round(to_mfu(images_per_sec_mean), 4),
+        "mfu_best": round(mfu_best, 4),
+        "mfu_mean": round(mfu(batch * train_flops, steps, mean), 4),
+        "measured_mfu": measured_mfu(main_prog, best, steps),
     })))
 
 

@@ -25,6 +25,7 @@ from paddle_tpu import compile_cache as _ccache
 from paddle_tpu import faults as _faults
 from paddle_tpu import monitor as _monitor
 from paddle_tpu import numerics as _numerics
+from paddle_tpu import roofline as _roofline
 from paddle_tpu.core import lowering
 from paddle_tpu.framework import (
     CPUPlace,
@@ -462,6 +463,13 @@ class Executor:
                     # honest sync (sampled=False walls are host-only —
                     # /trace and the fleet digest medians filter on it)
                     rec["sampled"] = sampled
+        # Roofline plane (roofline.py): profiles ride phase-SAMPLED
+        # steps — the honest device phase below supplies device time;
+        # take_sample counts them PER PROGRAM so the cadence is every
+        # Nth one, whatever else interleaves. Off (the default) this is
+        # the short-circuited `sampled` check.
+        roof = sampled and _roofline.take_sample(program)
+        cap = _roofline.begin_capture() if roof else None
         try:
             with _interp.spmd_ctx_scope(strategy), \
                     _monitor.span("executor.run_step"):
@@ -525,6 +533,16 @@ class Executor:
             # logged even when the step raises (NaN scan, device/runtime
             # error): the crashed step's record is the one an operator
             # needs for postmortem, and must be the last line of the log
+            if roof:
+                if t_b1 > 0.0:  # device drain completed: honest timing
+                    _roofline.note_step(
+                        program, lowered,
+                        device_s=t_b1 - t_c1,
+                        wall_s=time.perf_counter() - t_run0,
+                        capture=cap)
+                elif cap is not None:  # failed step: abandon the capture
+                    cap.stop()
+                    cap.cleanup()
             if tele:
                 # watermarks read AFTER the step (success or failure):
                 # the post-step high-water is the number an OOM
@@ -725,7 +743,7 @@ class Executor:
                          int(steps)),
                         program=program, kind="window",
                         compile_ms=compile_ms, strategy=None,
-                        cache_key=fp))
+                        cache_key=fp, window_steps=int(steps)))
             if _monitor.step_records_active():
                 rec = {
                     "kind": "window",
@@ -741,6 +759,11 @@ class Executor:
                 }
                 if ph:
                     rec["sampled"] = sampled
+        # roofline plane: window samples ride phase-sampled calls (see
+        # run(), one take_sample per window); the profile covers the
+        # whole window's steps
+        roof = sampled and _roofline.take_sample(program)
+        cap = _roofline.begin_capture() if roof else None
         # under check_nan_inf the window tracks per-step finiteness
         # IN-GRAPH (track_nonfinite): the compiled loop stays one
         # dispatch, yet a failure names the exact step inside it
@@ -814,6 +837,16 @@ class Executor:
                         rec["numerics"] = summary
         finally:
             # logged even when the window raises (see run())
+            if roof:
+                if t_b1 > 0.0:
+                    _roofline.note_step(
+                        program, lowered, steps=int(steps),
+                        device_s=t_b1 - t_c1,
+                        wall_s=time.perf_counter() - t_run0,
+                        capture=cap)
+                elif cap is not None:
+                    cap.stop()
+                    cap.cleanup()
             if tele:
                 _monitor.sample_device_memory(start, int(steps))
             if rec is not None:

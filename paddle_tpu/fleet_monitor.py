@@ -227,7 +227,15 @@ def registry_digest(rank: int = 0, world: int = 1,
     with _LOCK:
         seq = _pub_seq
         _pub_seq += 1
-    return {
+    # roofline rollup (optional field, schema stays v1): per-program
+    # measured MFU + verdict, so /fleet names each rank's MFU without
+    # shipping whole profiles through KV. Lazy via sys.modules — a
+    # worker that never loaded the plane publishes no section.
+    import sys as _sys
+
+    rl = _sys.modules.get("paddle_tpu.roofline")
+    roofline = rl.digest_section() if rl is not None else None
+    digest = {
         "v": _monitor.FLEET_DIGEST_SCHEMA_VERSION,
         "ts": time.time(),
         "seq": seq,
@@ -246,6 +254,9 @@ def registry_digest(rank: int = 0, world: int = 1,
         "steps": int(_monitor.counter(
             "pt_executor_steps_total").value()),
     }
+    if roofline is not None:
+        digest["roofline"] = roofline
+    return digest
 
 
 # ---------------------------------------------------------------------------

@@ -278,6 +278,42 @@ class Gauge:
         if dropped:
             _note_overflow(self.name, first)
 
+    def replace(self, values: Iterable[Tuple[Optional[Dict[str, Any]],
+                                             float]]):
+        """Atomically swap EVERY cell for ``values`` ([(labels, value),
+        ...]) — for gauges that mirror one bounded snapshot at a time
+        (e.g. the roofline plane's top-K op seconds, whose per-compile
+        HLO label values would otherwise accrete stale cells forever).
+        A concurrent scrape sees either the old set or the new one,
+        never a partial mix. The MAX_LABEL_SETS cap applies here too:
+        values past it are dropped (first-listed win — callers pass
+        rank order), metered into pt_metric_label_overflow_total and
+        warned once, same as every other mutator. No-op while
+        telemetry is off."""
+        if not _enabled:
+            return
+        cells: Dict[_LabelKey, float] = {}
+        dropped = 0
+        for labels, v in values:
+            key = _label_key(labels)
+            if len(cells) >= MAX_LABEL_SETS and key not in cells:
+                dropped += 1
+                continue
+            cells[key] = float(v)
+        with _LOCK:
+            first = dropped > 0 and not self._overflowed
+            self._cells = cells
+            # sticky, like _capped_key's lifetime-once contract: a
+            # small replace must not re-arm the once-only warning
+            self._overflowed = self._overflowed or dropped > 0
+        if dropped:
+            if first:
+                warnings.warn(
+                    f"metric '{self.name}' replace() exceeded "
+                    f"{MAX_LABEL_SETS} label-sets; {dropped} values "
+                    f"dropped", RuntimeWarning)
+            _overflow_total().inc(dropped, labels={"metric": self.name})
+
     def value(self, labels: Optional[Dict[str, Any]] = None) -> float:
         return self._cells.get(_label_key(labels), 0.0)
 
@@ -452,6 +488,9 @@ def reset():
     fm = sys.modules.get("paddle_tpu.fleet_monitor")
     if fm is not None:
         fm.reset()
+    rl = sys.modules.get("paddle_tpu.roofline")
+    if rl is not None:
+        rl.reset()
 
 
 def snapshot() -> Dict[str, Any]:
@@ -844,6 +883,11 @@ COMPILE_REPORT_FIELDS: Dict[str, tuple] = {
                      "op-lowering histogram)"),
     "strategy": ((str, type(None)), True,
                  "SPMD strategy id (mesh axes) or null"),
+    "window_steps": ((int, type(None)), False,
+                     "steps compiled into a 'window' report's program "
+                     "(its flops/bytes cover the WHOLE window; the "
+                     "roofline plane divides by this); absent on "
+                     "'step' reports"),
 }
 
 
@@ -1060,6 +1104,8 @@ ROUTES: Dict[str, str] = {
     "/trace": "Chrome-trace JSON timeline (Perfetto-loadable)",
     "/fleet": "JSON cluster view: per-rank digests, heartbeat ages, "
               "stragglers, OOM reports",
+    "/profile": "JSON roofline plane: latest device profile per "
+                "program (top ops, verdict, measured MFU)",
 }
 
 
@@ -1084,6 +1130,9 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
     - ``/fleet``    JSON cluster view: one row per rank (digest + phase
       breakdown + heartbeat age + dead flag) plus straggler records and
       OOM reports (fleet_monitor.py)
+    - ``/profile``  JSON roofline plane: latest device profile per
+      program — top ops by device seconds, roofline verdict, measured
+      MFU (roofline.py)
 
     Binds localhost by default: metrics can carry program names — scrape
     through a sidecar or port-forward, don't expose it."""
@@ -1160,6 +1209,14 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
                     from paddle_tpu import fleet_monitor as _fm
 
                     body = json.dumps(_fm.cluster_view(), sort_keys=True,
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif path == "/profile":
+                    # lazy import: roofline.py imports monitor.py
+                    from paddle_tpu import roofline as _roofline
+
+                    body = json.dumps(_roofline.summary(),
+                                      sort_keys=True,
                                       default=str).encode()
                     ctype = "application/json"
                 else:
@@ -1406,6 +1463,11 @@ FLEET_DIGEST_FIELDS: Dict[str, tuple] = {
     "steps": ((int,), True,
               "pt_executor_steps_total at publish time (bounds straggler "
               "detection latency in steps)"),
+    "roofline": ((dict, type(None)), False,
+                 "per-program roofline rollup from the device-profile "
+                 "plane: program -> {measured_mfu, verdict, source} "
+                 "(roofline.digest_section); absent before the first "
+                 "profile — optional, schema stays v1"),
 }
 
 

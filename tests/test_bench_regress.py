@@ -1,0 +1,210 @@
+"""Bench-trajectory regression gate (bench_regress.py): fixture-row
+checks, tolerance semantics, the CLI exit contract, and the committed
+BENCH_r*.json history gating itself."""
+
+import json
+import os
+
+import pytest
+
+import bench_regress
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _row(value_mean, metric="transformer_base_train_tokens_per_sec",
+         unit="tokens/sec", **extra):
+    row = {"metric": metric, "value": value_mean * 1.01,
+           "value_mean": value_mean, "unit": unit}
+    row.update(extra)
+    return row
+
+
+def _driver(parsed, n=1):
+    return {"n": n, "cmd": "python bench.py", "rc": 0, "parsed": parsed}
+
+
+def test_flatten_row_headline_nested_and_metrics_skipped():
+    parsed = _row(
+        100.0,
+        resnet50={"metric": "resnet50_train_images_per_sec",
+                  "value": 50.0, "unit": "images/sec"},
+        warm_start={"metric": "warm_start_ratio", "value": 0.8,
+                    "unit": "ratio"},
+        metrics={"pt_executor_steps_total": {"metric": "not_a_row",
+                                             "value": 1}},
+    )
+    flat = bench_regress.flatten_row(parsed)
+    assert flat["transformer_base_train_tokens_per_sec"]["value"] == 100.0
+    # value_mean preferred over value; nested rows without one fall back
+    assert flat["resnet50_train_images_per_sec"]["value"] == 50.0
+    assert flat["warm_start_ratio"]["unit"] == "ratio"
+    assert "not_a_row" not in flat  # the registry snapshot never gates
+
+
+def test_check_flags_twenty_percent_drop_and_passes_within_tolerance():
+    history = [("r01", bench_regress.flatten_row(_row(90.0))),
+               ("r02", bench_regress.flatten_row(_row(100.0)))]
+    # 20% below the trailing best (100) -> regression
+    (f,) = bench_regress.check(
+        bench_regress.flatten_row(_row(80.0)), history)
+    assert f["metric"] == "transformer_base_train_tokens_per_sec"
+    assert f["best"] == 100.0 and f["best_round"] == "r02"
+    assert f["ratio"] == pytest.approx(0.8)
+    # 5% below: inside the 10% tolerance
+    assert bench_regress.check(
+        bench_regress.flatten_row(_row(95.0)), history) == []
+    # improvements obviously pass
+    assert bench_regress.check(
+        bench_regress.flatten_row(_row(120.0)), history) == []
+
+
+def test_check_per_family_tolerance_and_ungated_units():
+    history = [("r01", {
+        "fam_tokens_per_sec": {"value": 100.0, "unit": "tokens/sec"},
+        "warm_start_seconds": {"value": 10.0, "unit": "seconds"},
+    })]
+    fresh = {
+        "fam_tokens_per_sec": {"value": 75.0, "unit": "tokens/sec"},
+        # lower-is-better rider got WORSE but its unit is not gated
+        "warm_start_seconds": {"value": 50.0, "unit": "seconds"},
+        # brand-new family: no history, never gates
+        "decode_tokens_per_sec": {"value": 1.0, "unit": "tokens/sec"},
+    }
+    (f,) = bench_regress.check(fresh, history)
+    assert f["metric"] == "fam_tokens_per_sec"
+    # a per-family override wider than the drop silences it
+    bench_regress.FAMILY_TOLERANCE["fam_tokens_per_sec"] = 0.30
+    try:
+        assert bench_regress.check(fresh, history) == []
+    finally:
+        bench_regress.FAMILY_TOLERANCE.pop("fam_tokens_per_sec")
+    # the global tolerance argument works the same way
+    assert bench_regress.check(fresh, history, tolerance=0.30) == []
+
+
+def test_check_flags_family_missing_from_fresh_row():
+    """A family whose bench subprocess crashed produces NO metric —
+    the worst regression must not pass by absence. The baseline is the
+    UNION of history rounds (one bad committed round without the
+    family must not erode the guarantee); deliberate removals need an
+    explicit RETIRED_METRICS entry."""
+    history = [
+        ("r01", {"old_tokens_per_sec": {"value": 5.0,
+                                        "unit": "tokens/sec"},
+                 "fam_tokens_per_sec": {"value": 90.0,
+                                        "unit": "tokens/sec"}}),
+        # r02 (the newest) itself lacks both old_* and crashy_* —
+        # carried-by-ANY-round still gates them
+        ("r02", {"fam_tokens_per_sec": {"value": 100.0,
+                                        "unit": "tokens/sec"}}),
+        ("r01b", {"crashy_images_per_sec": {"value": 40.0,
+                                            "unit": "images/sec"}}),
+    ]
+    fresh = {"fam_tokens_per_sec": {"value": 99.0,
+                                    "unit": "tokens/sec"}}
+    found = {f["metric"]: f for f in bench_regress.check(fresh, history)}
+    assert set(found) == {"old_tokens_per_sec", "crashy_images_per_sec"}
+    f = found["crashy_images_per_sec"]
+    assert f["missing"] is True and f["value"] is None
+    assert f["best"] == 40.0 and f["best_round"] == "r01b"
+    # a deliberate retirement is an explicit escape, not silence
+    old = bench_regress.RETIRED_METRICS
+    bench_regress.RETIRED_METRICS = frozenset({"old_tokens_per_sec"})
+    try:
+        (f2,) = bench_regress.check(fresh, history)
+        assert f2["metric"] == "crashy_images_per_sec"
+    finally:
+        bench_regress.RETIRED_METRICS = old
+    # present again -> no finding
+    fresh["crashy_images_per_sec"] = {"value": 41.0,
+                                      "unit": "images/sec"}
+    fresh["old_tokens_per_sec"] = {"value": 6.0, "unit": "tokens/sec"}
+    assert bench_regress.check(fresh, history) == []
+
+
+def _write_rounds(tmp_path, values):
+    paths = []
+    for i, v in enumerate(values, start=1):
+        p = tmp_path / f"BENCH_r{i:02d}.json"
+        p.write_text(json.dumps(_driver(_row(v), n=i)))
+        paths.append(str(p))
+    return paths
+
+
+def test_main_exits_nonzero_on_synthetic_drop(tmp_path, capsys):
+    _write_rounds(tmp_path, [90.0, 100.0, 79.0])  # fresh = 79 vs best 100
+    rc = bench_regress.main(
+        ["--history", str(tmp_path / "BENCH_r*.json")])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] is False and out["row"] == "BENCH_r03.json"
+    (f,) = out["regressions"]
+    assert f["ratio"] == pytest.approx(0.79)
+
+
+def test_main_passes_on_healthy_trajectory(tmp_path, capsys):
+    _write_rounds(tmp_path, [90.0, 100.0, 97.0])
+    rc = bench_regress.main(
+        ["--history", str(tmp_path / "BENCH_r*.json")])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["ok"] is True
+
+
+def test_main_row_mode_gates_fresh_row_against_all_rounds(tmp_path,
+                                                          capsys):
+    _write_rounds(tmp_path, [90.0, 100.0])
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(_row(70.0)))  # bare row, no wrapper
+    rc = bench_regress.main(
+        ["--history", str(tmp_path / "BENCH_r*.json"),
+         "--row", str(fresh)])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["row"] == "fresh.json"
+    assert out["rounds"] == ["BENCH_r01.json", "BENCH_r02.json"]
+    # the same fresh row passes once the tolerance covers the gap
+    rc = bench_regress.main(
+        ["--history", str(tmp_path / "BENCH_r*.json"),
+         "--row", str(fresh), "--tolerance", "0.5"])
+    assert rc == 0
+    capsys.readouterr()
+
+
+def test_main_needs_enough_history(tmp_path, capsys):
+    _write_rounds(tmp_path, [100.0])
+    rc = bench_regress.main(
+        ["--history", str(tmp_path / "BENCH_r*.json")])
+    assert rc == 2
+    capsys.readouterr()
+
+
+def test_committed_history_passes_the_gate(capsys):
+    """The acceptance row: the repo's own BENCH_r*.json trajectory must
+    pass — r05 gated against r01..r04 regresses nothing at the default
+    tolerance."""
+    rc = bench_regress.main(
+        ["--history", os.path.join(REPO, "BENCH_r*.json")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0, out["regressions"]
+    assert out["row"] == "BENCH_r05.json"
+    assert "transformer_base_train_tokens_per_sec" in out["gated_metrics"]
+
+
+def test_committed_history_flags_synthetic_twenty_percent_drop(
+        tmp_path, capsys):
+    """The other acceptance half: a synthetic 20% throughput drop on
+    the REAL history is flagged."""
+    r05 = json.load(open(os.path.join(REPO, "BENCH_r05.json")))["parsed"]
+    degraded = json.loads(json.dumps(r05))  # deep copy
+    for key in ("value", "value_mean"):
+        degraded[key] = r05[key] * 0.8
+    fresh = tmp_path / "degraded.json"
+    fresh.write_text(json.dumps(degraded))
+    rc = bench_regress.main(
+        ["--history", os.path.join(REPO, "BENCH_r*.json"),
+         "--row", str(fresh)])
+    assert rc == 1
+    out = json.loads(capsys.readouterr().out)
+    assert any(f["metric"] == "transformer_base_train_tokens_per_sec"
+               for f in out["regressions"])

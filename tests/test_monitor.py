@@ -102,6 +102,36 @@ def test_disabled_calls_are_inert_and_allocation_free():
     assert not c._cells and not g._cells and not h._cells
 
 
+def test_gauge_replace_swaps_cells_and_honors_label_cap():
+    """Gauge.replace (the roofline plane's wholesale top-K mirror):
+    the swap is total — no stale cells survive — and the
+    MAX_LABEL_SETS cap applies exactly like every other mutator (an
+    unclamped device_profile_top_k must not grow the registry without
+    bound): first-listed values win, drops warn once and count into
+    pt_metric_label_overflow_total."""
+    monitor.enable()
+    g = monitor.gauge("t_repl_g", "replaced gauge")
+    g.set(1.0, labels={"op": "stale"})
+    g.replace([({"op": "a"}, 2.0), ({"op": "b"}, 3.0)])
+    assert g.value(labels={"op": "a"}) == 2.0
+    assert g.value(labels={"op": "stale"}) == 0.0  # swap is total
+    assert len(g._cells) == 2
+    with pytest.warns(RuntimeWarning, match="label-sets"):
+        g.replace([({"i": i}, float(i))
+                   for i in range(monitor.MAX_LABEL_SETS + 7)])
+    assert len(g._cells) == monitor.MAX_LABEL_SETS
+    # rank order: the first N values win, the tail is dropped
+    assert g.value(labels={"i": 1}) == 1.0
+    assert g.value(labels={"i": monitor.MAX_LABEL_SETS + 1}) == 0.0
+    assert monitor.counter("pt_metric_label_overflow_total").value(
+        labels={"metric": "t_repl_g"}) == 7
+    # disabled: replace is a no-op like every mutator
+    monitor.disable()
+    g.replace([({"op": "z"}, 9.0)])
+    assert g.value(labels={"op": "z"}) == 0.0
+    monitor.enable()
+
+
 def test_label_cardinality_cap_collapses_into_overflow_bucket():
     """A mis-labelled hot-path metric (step index in a label) must not
     grow registry memory without bound: past MAX_LABEL_SETS distinct
