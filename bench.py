@@ -144,7 +144,8 @@ def main():
         try:
             env = {**os.environ, "PT_BENCH_RESNET": "0",
                    "PT_BENCH_LONGCTX": "0", "PT_BENCH_WARMSTART": "0",
-                   "PT_BENCH_PIPELINE": "0", **env_extra}
+                   "PT_BENCH_PIPELINE": "0", "PT_BENCH_SERVING": "0",
+                   **env_extra}
             out = subprocess.run(argv, capture_output=True, text=True,
                                  timeout=900, env=env)
             if out.returncode != 0:
@@ -164,7 +165,7 @@ def main():
                           "long_context_t4096", "long_context_t8192",
                           "se_resnext50",
                           "bert_base", "deepfm", "ssd300", "warm_start",
-                          "pipeline"):
+                          "pipeline", "serving"):
                     parsed.pop(k, None)
             return parsed
         except Exception as e:  # never let a rider kill the headline
@@ -179,8 +180,9 @@ def main():
     want_families = os.environ.get("PT_BENCH_FAMILIES", "1") == "1"
     want_warmstart = os.environ.get("PT_BENCH_WARMSTART", "1") == "1"
     want_pipeline = os.environ.get("PT_BENCH_PIPELINE", "1") == "1"
+    want_serving = os.environ.get("PT_BENCH_SERVING", "1") == "1"
     if (want_resnet or want_longctx or want_families or want_warmstart
-            or want_pipeline):
+            or want_pipeline or want_serving):
         del feeds
         fluid.executor.global_scope().clear()
         exe.close()
@@ -214,6 +216,15 @@ def main():
         warm_start = _rider(
             [sys.executable, os.path.join(here, "bench_warmstart.py")], {})
         log(f"warm_start: {warm_start}")
+    serving_row = None
+    if want_serving:
+        # continuous-batching decode: tokens/s + per-token latency
+        # quantiles under a concurrency sweep through the serving
+        # engine's prefill/decode split (zero fresh compiles after
+        # warmup is the correctness rider)
+        serving_row = _rider(
+            [sys.executable, os.path.join(here, "bench_serving.py")], {})
+        log(f"serving: {serving_row}")
     pipeline_row = None
     if want_pipeline:
         # sync vs pipelined trainer steady-state step time + the final
@@ -254,6 +265,7 @@ def main():
         "ssd300": families.get("ssd300"),
         "warm_start": warm_start,
         "pipeline": pipeline_row,
+        "serving": serving_row,
     })))
 
 

@@ -1,0 +1,174 @@
+"""Benchmark rider: serving-plane throughput + latency under a
+concurrency sweep (serving.py ServingEngine — continuous batching over
+the on-device KV cache).
+
+For each concurrency level C the harness spins the SAME engine geometry
+(``slots`` batch slots), submits 2*C requests with C in flight, and
+drives the scheduler loop, timing every decode step on the host: each
+emitted token's latency is its step's wall time, so p50/p95/p99
+per-token latency and time-to-first-token come from real dispatch->host
+measurements, not histogram interpolation.
+
+Prints ONE JSON line in the driver format: ``value`` is tokens/s at
+full concurrency, ``vs_baseline`` is the continuous-batching speedup
+over solo decode divided by slots/2 (target: batching S slots must beat
+solo throughput by at least S/2; >1.0 beats it). The solo row, the full
+sweep, and the decode-loop executor-cache accounting (zero fresh
+compiles after warmup is the acceptance bar) ride along.
+
+Env knobs: ``PT_BENCH_CPU=1`` forces the CPU backend;
+``PT_BENCH_SERVE_SIZE=tiny|base`` picks the model (tiny for CPU smokes);
+``PT_BENCH_SERVE_SLOTS`` (default 8), ``PT_BENCH_SERVE_SRC`` source
+length (default 32), ``PT_BENCH_SERVE_NEW`` max new tokens per request
+(default 24).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+SLOTS = int(os.environ.get("PT_BENCH_SERVE_SLOTS", "8"))
+SRC_LEN = int(os.environ.get("PT_BENCH_SERVE_SRC", "32"))
+MAX_NEW = int(os.environ.get("PT_BENCH_SERVE_NEW", "24"))
+SIZE = os.environ.get("PT_BENCH_SERVE_SIZE", "base")
+
+
+def log(msg):
+    print(f"[bench_serving] {msg}", file=sys.stderr, flush=True)
+
+
+def _configure_platform():
+    if os.environ.get("PT_BENCH_CPU", "0") != "1":
+        return
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _cfg():
+    from paddle_tpu.models import transformer as T
+
+    if SIZE == "tiny":
+        return T.TransformerConfig(
+            src_vocab_size=512, trg_vocab_size=512,
+            max_length=max(64, SRC_LEN + MAX_NEW + 2),
+            d_model=64, d_inner=128, n_head=4, n_layer=2,
+            dropout=0.0, label_smooth_eps=0.0)
+    return T.TransformerConfig(
+        src_vocab_size=10000, trg_vocab_size=10000,
+        max_length=max(256, SRC_LEN + MAX_NEW + 2),
+        d_model=512, d_inner=2048, n_head=8, n_layer=6,
+        dropout=0.0, label_smooth_eps=0.0)
+
+
+def _sweep_level(cfg, scope, concurrency, n_requests, monitor):
+    """Drive one concurrency level; returns the measured row."""
+    from paddle_tpu import serving
+
+    eng = serving.ServingEngine(cfg, scope, slots=SLOTS, src_len=SRC_LEN,
+                                max_len=SRC_LEN + MAX_NEW + 1,
+                                queue_depth=max(64, n_requests))
+    rng = np.random.RandomState(17)
+    srcs = [rng.randint(2, cfg.src_vocab_size, (SRC_LEN,)).astype(np.int64)
+            for _ in range(n_requests)]
+    # warmup: compile prefill + decode before the timed window
+    w = eng.submit(srcs[0], max_new_tokens=2)
+    eng.run_until_idle()
+    assert w.done
+    misses0 = monitor.counter("pt_executor_cache_misses_total").value()
+
+    inflight = []
+    pending = list(srcs)
+    token_lat = []
+    ttft = []
+    t0 = time.perf_counter()
+    tokens = 0
+    while pending or eng.busy():
+        while pending and len([r for r in inflight if not r.done]) \
+                < concurrency:
+            inflight.append(eng.submit(pending.pop(0),
+                                       max_new_tokens=MAX_NEW))
+        ts = time.perf_counter()
+        emitted = eng.step()
+        dt = time.perf_counter() - ts
+        tokens += emitted
+        token_lat.extend([dt] * emitted)
+    wall = time.perf_counter() - t0
+    fresh = monitor.counter(
+        "pt_executor_cache_misses_total").value() - misses0
+    ttft = [r.ttft_s for r in inflight if r.ttft_s is not None]
+    done = sum(1 for r in inflight if r.outcome in ("completed", "length"))
+    eng.close()
+    lat = np.asarray(token_lat) if token_lat else np.asarray([0.0])
+    return {
+        "concurrency": concurrency,
+        "requests": done,
+        "tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 2) if wall else 0.0,
+        "token_ms_p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "token_ms_p95": round(float(np.percentile(lat, 95)) * 1e3, 3),
+        "token_ms_p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        "ttft_ms_p50": round(float(np.percentile(ttft, 50)) * 1e3, 3)
+        if ttft else None,
+        "fresh_compiles_after_warmup": int(fresh),
+    }
+
+
+def main():
+    _configure_platform()
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import flags, monitor
+    from paddle_tpu.models import transformer as T
+
+    flags.set_flags({"telemetry": True})
+    log(f"backend: {jax.default_backend()}, size={SIZE}, slots={SLOTS}, "
+        f"src={SRC_LEN}, new={MAX_NEW}")
+    cfg = _cfg()
+    scope = fluid.Scope()
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        T.build(cfg, is_test=True)
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+    levels = sorted({1, max(2, SLOTS // 2), SLOTS})
+    sweep = {}
+    for c in levels:
+        row = _sweep_level(cfg, scope, c, max(2 * c, c + 1), monitor)
+        sweep[f"c{c}"] = row
+        log(f"concurrency {c}: {row}")
+    solo = sweep[f"c{levels[0]}"]
+    full = sweep[f"c{SLOTS}"]
+    speedup = (full["tokens_per_sec"] / solo["tokens_per_sec"]
+               if solo["tokens_per_sec"] else 0.0)
+    print(json.dumps({
+        "metric": "serving_decode_tokens_per_sec",
+        "value": full["tokens_per_sec"],
+        "unit": "tokens/sec",
+        # target: batching SLOTS slots beats solo decode by >= SLOTS/2
+        "vs_baseline": round(speedup / (SLOTS / 2.0), 3),
+        "slots": SLOTS,
+        "src_len": SRC_LEN,
+        "max_new_tokens": MAX_NEW,
+        "model": SIZE,
+        "batching_speedup": round(speedup, 3),
+        "solo_tokens_per_sec": solo["tokens_per_sec"],
+        "token_ms_p50": full["token_ms_p50"],
+        "token_ms_p95": full["token_ms_p95"],
+        "token_ms_p99": full["token_ms_p99"],
+        "ttft_ms_p50": full["ttft_ms_p50"],
+        "fresh_compiles_after_warmup": full["fresh_compiles_after_warmup"],
+        "sweep": sweep,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -39,6 +39,7 @@ from paddle_tpu import (  # noqa: F401
     passes,
     profiler,
     retry,
+    serving,
     transpiler,
 )
 from paddle_tpu.dataset_api import DatasetFactory  # noqa: F401

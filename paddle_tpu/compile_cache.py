@@ -73,7 +73,11 @@ from paddle_tpu import monitor as _monitor
 
 # Bump on any incompatible change to the on-disk payload layout; a
 # version mismatch is a silent miss, never an error.
-FORMAT_VERSION = 1
+# v2: stored executables are compiled WITHOUT input donation — a
+# deserialized donating executable corrupts buffer ownership from its
+# second call on (jax 0.4.x flaky use-after-free, first surfaced by the
+# serving plane's multi-call decode entries); v1 entries must miss.
+FORMAT_VERSION = 2
 
 _M_HITS = _monitor.counter(
     "pt_compile_cache_hits_total",
@@ -414,18 +418,38 @@ def executor_spec(program, *, feed_vals, fetch_names, scope, base_key,
         return None
 
 
+def _canon_host_array(v):
+    """Match jax.jit's input canonicalization for a host array. The
+    eager jit casts non-canonical host inputs (int64 -> int32 with x64
+    off) during device_put; a ``jax.stages.Compiled`` does NOT — it was
+    compiled for the canonical aval, and handing it the raw 64-bit
+    buffer reinterprets the bytes (garbage values, and observed heap
+    corruption on jax 0.4.37). Training-state entries never hit this
+    (all-f32 params); the serving programs' int64/bool decode state is
+    what first tripped it."""
+    if isinstance(v, np.ndarray):
+        want = jax.dtypes.canonicalize_dtype(v.dtype)
+        if want != v.dtype:
+            return v.astype(want)
+    return v
+
+
 def _wrap(comp, static_steps: Optional[int]):
     """Wrap an AOT ``jax.stages.Compiled`` in the executor's call
     convention. run_steps entries bake ``steps`` as a static argument, so
     the wrapper drops the trailing count the eager jit would re-dispatch
     on (the executor keys entries by ``steps``, making a mismatch
-    impossible)."""
+    impossible). Host inputs are canonicalized exactly as the eager jit
+    would (see _canon_host_array)."""
+    _canon = jax.tree_util.tree_map
     if static_steps is None:
         def fn(state, feeds, base_key, step):
-            return comp(state, feeds, base_key, step)
+            return comp(*_canon(_canon_host_array,
+                                (state, feeds, base_key, step)))
     else:
         def fn(state, feeds, base_key, start, n_steps):
-            return comp(state, feeds, base_key, start)
+            return comp(*_canon(_canon_host_array,
+                                (state, feeds, base_key, start)))
     # build_compile_report() reuses this executable for cost/memory
     # analysis instead of AOT-compiling a twin
     fn._pt_compiled = comp
@@ -540,7 +564,15 @@ def aot_build(spec: Spec, jitfn):
     against the spec's example arguments (ONE trace + ONE XLA compile —
     the eager jit is never invoked), persist the executable, and return
     the wrapped entry callable. Returns None when AOT compilation itself
-    fails; the caller keeps the eager jit and nothing is stored."""
+    fails; the caller keeps the eager jit and nothing is stored.
+
+    ``jitfn`` must be the DONATION-FREE twin (executor._jit_for
+    donate_state=False): a donating executable round-tripped through
+    serialize/deserialize mishandles buffer ownership from its second
+    call on (jax 0.4.x — flaky use-after-free observed as garbage KV
+    caches and glibc heap aborts in the serving decode loop). The cost
+    is one extra in-flight copy of the state in disk-tier processes;
+    the value contract is what the tier exists for."""
     try:
         from paddle_tpu.core import interp as _interp
 

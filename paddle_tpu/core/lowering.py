@@ -189,7 +189,8 @@ def jit_lowered(
 
 
 def jit_lowered_multi(lowered: LoweredBlock, n_feeds: int,
-                      track_nonfinite: bool = False):
+                      track_nonfinite: bool = False,
+                      donate_state: bool = True):
     """Compile ``n_steps`` training steps as ONE XLA program.
 
     The returned fn has signature
@@ -279,7 +280,13 @@ def jit_lowered_multi(lowered: LoweredBlock, n_feeds: int,
             return fetches, {**st, **ex}, bad
         return fetches, {**st, **ex}
 
-    return jax.jit(multi_fn, static_argnums=(4,), donate_argnums=(0,))
+    kwargs: Dict[str, Any] = {}
+    if donate_state:
+        # the serialized-executable tier compiles a donation-free twin:
+        # deserialized donating executables mishandle buffer ownership
+        # from their second call on (jax 0.4.x) — see compile_cache.py
+        kwargs["donate_argnums"] = (0,)
+    return jax.jit(multi_fn, static_argnums=(4,), **kwargs)
 
 
 # ---------------------------------------------------------------------------

@@ -330,6 +330,11 @@ class Executor:
                 program, compiled, feed_names, run_fetch_names, scope
             )
 
+        def pure_build(lowered):
+            # the donation-free twin the disk tier stores (see
+            # _cache_entry / _jit_for)
+            return self._jit_for(lowered, compiled, donate_state=False)
+
         spec_factory = None
         if use_program_cache and _ccache.active():
             # level-2 disk tier: the spec (state avals gathered from the
@@ -362,7 +367,7 @@ class Executor:
                 program, {k: np.shape(v) for k, v in feed_vals.items()})
         if use_program_cache:
             entry, outcome, evictions, compile_ms = self._cache_entry(
-                key, build, spec_factory, program)
+                key, build, spec_factory, program, pure_build=pure_build)
         else:
             entry, compile_ms = self._timed_build(build, program)
             outcome, evictions = "miss", 0
@@ -697,6 +702,12 @@ class Executor:
                                                track_nonfinite=nan_track),
                     lowered)
 
+        def pure_build(lowered):
+            # donation-free twin for the disk tier (see _cache_entry)
+            return lowering.jit_lowered_multi(
+                lowered, len(feed_list), track_nonfinite=nan_track,
+                donate_state=False)
+
         spec_factory = None
         if _ccache.active():
             # level-2 disk tier (see run()): built only on a level-1 miss
@@ -723,7 +734,7 @@ class Executor:
                 program,
                 {k: tuple(v.shape[1:]) for k, v in stacked.items()})
         entry, outcome, evictions, compile_ms = self._cache_entry(
-            key, build, spec_factory, program)
+            key, build, spec_factory, program, pure_build=pure_build)
         cache_hit = outcome != "miss"
         fn, lowered = entry
         state = self._gather_state(scope, lowered)
@@ -863,7 +874,8 @@ class Executor:
 
     # --- shared plumbing for run()/run_steps() ---
 
-    def _cache_entry(self, key, build, spec_factory=None, program=None):
+    def _cache_entry(self, key, build, spec_factory=None, program=None,
+                     pure_build=None):
         """LRU lookup-or-build with the capacity eviction policy and the
         persistent level-2 tier (compile_cache.py) between them.
 
@@ -899,10 +911,17 @@ class Executor:
                 # spec (one trace + one XLA compile — the same cost the
                 # eager jit would pay lazily) and persist the executable
                 # for the next process; an AOT failure keeps the eager
-                # jit and stores nothing.
+                # jit and stores nothing. The AOT twin is built WITHOUT
+                # input donation (``pure_build``): a deserialized
+                # donating executable corrupts buffer ownership from its
+                # second call on (jax 0.4.x flaky use-after-free), and a
+                # stored entry must execute correctly in every process —
+                # the memory win of donation is not worth wrong values.
                 def build_aot(_build=build):
                     fn, lowered = _build()
-                    aot = _ccache.aot_build(spec, fn)
+                    target = (pure_build(lowered)
+                              if pure_build is not None else fn)
+                    aot = _ccache.aot_build(spec, target)
                     return (fn if aot is None else aot), lowered
 
                 entry, compile_ms = self._timed_build(build_aot, program)
@@ -1127,6 +1146,21 @@ class Executor:
         # staging follows its owning entries out (see _cache_entry)
         self._staged.clear()
 
+    def release_scope(self, scope) -> int:
+        """Drop every compiled entry (and the staged feed windows it
+        owns) keyed to ``scope`` — the per-tenant half of close() for
+        executors shared by several predictors/serving engines: one
+        replica's retirement must not cold-start its neighbors. Returns
+        the number of entries released."""
+        uid = scope._uid
+        victims = [k for k in self._cache if len(k) > 1 and k[1] == uid]
+        for k in victims:
+            self._cache.pop(k, None)
+            for sk in [s for s, e in self._staged.items()
+                       if e["owner"] == k]:
+                self._staged.pop(sk, None)
+        return len(victims)
+
     @staticmethod
     def _check_nan_inf(fetch_names, fetches, new_state):
         """Per-step NaN/Inf scan of fetches + updated state
@@ -1152,6 +1186,16 @@ class Executor:
 
     def _compile(self, program, compiled, feed_names, fetch_names, scope):
         lowered = lowering.lower_block(program, 0, feed_names, fetch_names)
+        return self._jit_for(lowered, compiled), lowered
+
+    @staticmethod
+    def _jit_for(lowered, compiled, donate_state=True):
+        """jax.jit wrapper in the executor call convention.
+        ``donate_state=False`` builds the serialization-safe twin the
+        persistent compile cache stores (see compile_cache.aot_build):
+        deserialized DONATING executables corrupt buffer ownership from
+        their second call on (jax 0.4.x use-after-free), so disk-tier
+        executables run without input donation."""
         in_shardings = out_shardings = None
         if compiled is not None:
             in_shardings, out_shardings = compiled.shardings(lowered)
@@ -1159,8 +1203,7 @@ class Executor:
                 # align with fn(state, feeds, key, step)
                 repl = in_shardings[2]
                 in_shardings = (*in_shardings, repl)
-        fn = lowering.jit_lowered(
+        return lowering.jit_lowered(
             lowered, in_shardings=in_shardings, out_shardings=out_shardings,
-            fold_step=True,
+            fold_step=True, donate_state=donate_state,
         )
-        return fn, lowered

@@ -183,6 +183,15 @@ BUILTIN_SITES = {
     "ccache.store": "persistent compile-cache staged write, pre-rename "
                     "(compile_cache.store; raise/truncate = torn store — "
                     "the atomic publish must leave no torn entry)",
+    "serve.enqueue": "serving request intake, pre-queue (serving.py "
+                     "ServingEngine.submit; raise = failed admission "
+                     "path — the request must surface the error, not "
+                     "hang)",
+    "serve.decode": "serving decode loop, pre-dispatch of each "
+                    "single-token step (serving.py; delay = a stalled "
+                    "decode loop for SLO drills; raise fires BEFORE the "
+                    "step so device KV state stays consistent and the "
+                    "engine can keep serving)",
 }
 
 

@@ -185,6 +185,15 @@ _DEFS: Dict[str, tuple] = {
     # path is then one boolean check, zero allocations)
     "static_lint": (str, "warn",
                     "pre-compile static verifier: off|warn|error"),
+    # serving plane (serving.py): request-queue backpressure — submit()
+    # raises QueueFull (and counts the request rejected) once this many
+    # requests are waiting for a batch slot
+    "serve_queue_depth": (int, 64, "serving request-queue capacity"),
+    # default per-request deadline for serving engines: a request still
+    # decoding past its deadline is evicted at the next token boundary
+    # (outcome 'expired', partial output kept); 0 = no deadline. A
+    # submit(deadline_ms=) overrides per request.
+    "serve_deadline_ms": (int, 0, "default serving request deadline"),
     # unified retry policy (retry.py) used by fleet connect/kv/heartbeat:
     # first backoff sleep; subsequent sleeps take decorrelated jitter in
     # [base, 3*prev] capped at retry_max_delay_ms

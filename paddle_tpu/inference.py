@@ -33,6 +33,7 @@ class Config:
         self.params_filename = params_filename
         self._use_tpu = True
         self._use_bf16 = False
+        self._batch_buckets: tuple = ()
 
     def disable_tpu(self):
         self._use_tpu = False
@@ -44,12 +45,36 @@ class Config:
         self._use_bf16 = True
         return self
 
+    def set_batch_buckets(self, sizes):
+        """Serve variable-size request batches through a FIXED set of
+        compiled batch shapes: ``run`` pads each batch up to the nearest
+        bucket (chunking by the largest when it overflows), so the
+        executor compiles at most ``len(sizes)`` executables instead of
+        one per observed batch size (the reference predictor's dynamic
+        batching, without per-shape TRT engine rebuilds)."""
+        sizes = sorted({int(s) for s in sizes})
+        if not sizes or sizes[0] < 1:
+            raise ValueError(f"batch buckets must be positive: {sizes}")
+        self._batch_buckets = tuple(sizes)
+        return self
+
+    def enable_compile_cache(self, cache_dir: str):
+        """Route this process through the persistent compile cache
+        (sets the global ``compile_cache_dir`` flag): a fresh serving
+        replica loading a known model resolves its executables from
+        disk — zero fresh XLA compiles at spin-up."""
+        from paddle_tpu import flags as _flags
+
+        _flags.set_flags({"compile_cache_dir": cache_dir})
+        return self
+
 
 class Predictor:
     """Compiled-program predictor (reference: AnalysisPredictor::Run)."""
 
     def __init__(self, config: Config):
         self._config = config
+        self._closed = False
         self.scope = Scope()
         self._exe = Executor(
             TPUPlace(0) if config._use_tpu else CPUPlace()
@@ -114,12 +139,73 @@ class Predictor:
         -> list of output arrays. Compiled executables are cached per
         feed signature; parameters stay device-resident in the
         predictor's private scope and round-trip through each call via
-        buffer donation (XLA aliases the unchanged buffers, so no copy)."""
+        buffer donation (XLA aliases the unchanged buffers, so no copy).
+        With ``Config.set_batch_buckets`` the batch dim is padded to the
+        nearest bucket first, so the executable set stays at the bucket
+        count whatever batch sizes arrive."""
         feed = self._as_feed(inputs)
+        if self._config._batch_buckets:
+            return self._run_bucketed(feed)
+        return self._run_exact(feed)
+
+    def _run_exact(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        if self._closed:
+            raise RuntimeError("Predictor.run after close()")
         with scope_guard(self.scope):
             return self._exe.run(
                 self.program, feed=feed, fetch_list=self._fetch_vars
             )
+
+    def _run_bucketed(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        """Pad each chunk's batch dim up to a bucket shape and trim the
+        padding back off the (batch-major) outputs."""
+        buckets = self._config._batch_buckets
+
+        def pick(remaining: int):
+            take = min(remaining, buckets[-1])
+            return take, next(s for s in buckets if s >= take)
+
+        return self._run_padded_chunks(feed, pick)
+
+    def _run_padded_chunks(self, feed, pick) -> List[np.ndarray]:
+        """Shared fixed-signature batching core (run_batch and the
+        bucketed run): split the batch into chunks sized by
+        ``pick(remaining) -> (take, padded_size)``, zero-pad each chunk
+        to its padded size, run, validate every fetch is batch-major
+        over that size, trim the padding, and concatenate."""
+        n = int(np.shape(next(iter(feed.values())))[0])
+        if n == 0:
+            raise ValueError("run got an empty (0-row) batch")
+        for k, v in feed.items():
+            if np.shape(v)[0] != n:
+                raise ValueError(
+                    f"input '{k}' batch {np.shape(v)[0]} != {n}")
+        outs: List[List[np.ndarray]] = []
+        lo = 0
+        while lo < n:
+            take, b = pick(n - lo)
+            chunk = {k: np.asarray(v)[lo:lo + take]
+                     for k, v in feed.items()}
+            if take < b:
+                chunk = {
+                    k: np.concatenate(
+                        [v, np.zeros((b - take,) + v.shape[1:], v.dtype)])
+                    for k, v in chunk.items()
+                }
+            res = [np.asarray(r) for r in self._run_exact(chunk)]
+            for i, r in enumerate(res):
+                if r.ndim == 0 or r.shape[0] != b:
+                    raise ValueError(
+                        f"fetch #{i} has shape {r.shape}, not "
+                        f"batch-major over batch {b}; batch-aggregated "
+                        f"or scalar outputs cannot be re-chunked — "
+                        f"fetch them via an exact-shape run() instead")
+            outs.append([r[:take] for r in res])
+            lo += take
+        if len(outs) == 1:
+            return outs[0]
+        return [np.concatenate([o[i] for o in outs])
+                for i in range(len(self._fetch_vars))]
 
     def warmup(self, inputs=None, shapes: Optional[Dict[str, tuple]] = None,
                dtypes: Optional[Dict[str, str]] = None):
@@ -151,36 +237,23 @@ class Predictor:
         request size — the static-shape answer to the reference
         predictor's dynamic batching (no recompiles in steady state)."""
         feed = self._as_feed(inputs)
-        n = next(iter(feed.values())).shape[0]
-        if n == 0:
-            raise ValueError("run_batch got an empty (0-row) batch")
-        for k, v in feed.items():
-            if v.shape[0] != n:
-                raise ValueError(
-                    f"input '{k}' batch {v.shape[0]} != {n}")
-        outs: List[List[np.ndarray]] = []
-        for lo in range(0, n, max_batch_size):
-            chunk = {k: v[lo:lo + max_batch_size] for k, v in feed.items()}
-            got = chunk[self._feed_names[0]].shape[0]
-            if got < max_batch_size:
-                chunk = {
-                    k: np.concatenate(
-                        [v, np.zeros((max_batch_size - got,) + v.shape[1:],
-                                     v.dtype)])
-                    for k, v in chunk.items()
-                }
-            res = self.run(chunk)
-            res = [np.asarray(r) for r in res]
-            for i, r in enumerate(res):
-                if r.ndim == 0 or r.shape[0] != max_batch_size:
-                    raise ValueError(
-                        f"run_batch fetch #{i} has shape {r.shape}, not "
-                        f"batch-major over batch {max_batch_size}; "
-                        "batch-aggregated or scalar outputs cannot be "
-                        "re-chunked — fetch them via run() instead")
-            outs.append([r[:got] for r in res])
-        return [np.concatenate([o[i] for o in outs])
-                for i in range(len(self._fetch_vars))]
+        return self._run_padded_chunks(
+            feed, lambda remaining: (min(remaining, max_batch_size),
+                                     max_batch_size))
+
+
+    def close(self):
+        """Release the predictor's compiled entries + staged feeds
+        (mirroring ``Executor.close`` scoped to this predictor's private
+        Scope) and drop its device-resident parameters. Idempotent; a
+        ``run`` after close raises. The reference parity point is
+        AnalysisPredictor's destructor releasing its per-predictor
+        scope/engine state."""
+        if self._closed:
+            return
+        self._closed = True
+        self._exe.release_scope(self.scope)
+        self.scope.clear()
 
 
 def create_predictor(config: Config) -> Predictor:

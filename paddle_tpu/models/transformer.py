@@ -334,6 +334,24 @@ def make_batch(cfg: TransformerConfig, batch: int, src_len: int, trg_len: int,
 # into a single XLA computation) ---
 
 
+def _encode_source(src, src_pad, cfg: TransformerConfig):
+    """Encoder stack over a padded source batch (weights shared with
+    build() by parameter name). Returns ``(enc [b, s, d], enc_bias
+    [b, 1, 1, s])`` — the shared front half of every decode-side
+    program (beam decode, serving prefill)."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    helper = LayerHelper("encode_src")
+    enc_bias = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("attn_bias", inputs={"PadMask": src_pad},
+                     outputs={"Out": enc_bias}, attrs={"causal": False})
+    enc = _embed(src, cfg.src_vocab_size, cfg, "src_emb.w", "src_pos.w",
+                 True)
+    for i in range(cfg.n_layer):
+        enc = encoder_layer(enc, enc_bias, cfg, i, True)
+    return _ln(enc, "enc_post"), enc_bias
+
+
 def build_decode(cfg: Optional[TransformerConfig] = None, beam_size: int = 4,
                  max_len: int = 32, src_len: int = 32, bos_id: int = 0,
                  end_id: int = 1):
@@ -365,11 +383,7 @@ def build_decode(cfg: Optional[TransformerConfig] = None, beam_size: int = 4,
         return outs[0]
 
     # encoder (shared weights with build() by parameter name)
-    enc_bias = _op("attn_bias", {"PadMask": src_pad}, {"causal": False})
-    enc = _embed(src, cfg.src_vocab_size, cfg, "src_emb.w", "src_pos.w", True)
-    for i in range(cfg.n_layer):
-        enc = encoder_layer(enc, enc_bias, cfg, i, True)
-    enc = _ln(enc, "enc_post")
+    enc, enc_bias = _encode_source(src, src_pad, cfg)
 
     # replicate encoder state per beam: [b,s,d] -> [b*K,s,d]
     enc_beam = layers.reshape(
@@ -826,6 +840,274 @@ def build_scan(cfg: Optional[TransformerConfig] = None,
         "token_count": token_count,
         "config": cfg,
     }
+
+
+# --- serving-plane programs: prefill + single-token KV-cache decode ---
+#
+# build_decode() above re-runs the decoder over the full prefix every
+# step (O(T^2) per emitted token) and owns its whole batch for the whole
+# decode — fine for offline translation, wrong for serving. The serving
+# split (serving.py ServingEngine) compiles TWO programs per engine:
+#
+# - build_prefill: admit ONE request into a batch *slot* — run the
+#   encoder once, project every decoder layer's cross-attention K/V, and
+#   write them (plus reset per-slot decode state) into slot-indexed
+#   persistable cache tensors that stay device-resident between steps.
+# - build_decode_step: ONE token for EVERY slot — embed each slot's
+#   current token at its own position, append this step's self-attention
+#   K/V rows to the on-device cache (ops/serving_ops.py kv_cache_write),
+#   attend over the per-slot visible prefix (kv_step_bias), and emit the
+#   greedy next token, all as one fixed-shape XLA computation. O(T) per
+#   token, one compiled executable for any mix of in-flight requests.
+#
+# Cache state (per engine, shapes from serving_state_specs) carries
+# through the executor's ordinary donated-state path: the executor
+# gathers the persistable vars from the serving scope, donates them to
+# XLA (in-place update on device), and commits the returned buffers —
+# the KV cache never round-trips through the host.
+
+
+def serving_state_specs(cfg: TransformerConfig, slots: int, src_len: int,
+                        max_len: int) -> Dict[str, tuple]:
+    """name -> (shape, numpy dtype) for the engine's device-resident
+    serving state. ``serve_k/v{i}`` are the decoder self-attention KV
+    rings (slot x position), ``serve_ck/cv{i}`` the per-request
+    cross-attention K/V written at prefill, plus per-slot scalars:
+    current token, its position, and the live flag."""
+    h, dh = cfg.n_head, cfg.d_head
+    specs: Dict[str, tuple] = {
+        "serve_cur_ids": ((slots,), "int64"),
+        "serve_pos": ((slots,), "int64"),
+        "serve_live": ((slots,), "bool"),
+        "serve_cross_bias": ((slots, 1, 1, src_len), "float32"),
+    }
+    for i in range(cfg.n_layer):
+        specs[f"serve_k{i}"] = ((slots, max_len, h, dh), cfg.dtype)
+        specs[f"serve_v{i}"] = ((slots, max_len, h, dh), cfg.dtype)
+        specs[f"serve_ck{i}"] = ((slots, src_len, h, dh), cfg.dtype)
+        specs[f"serve_cv{i}"] = ((slots, src_len, h, dh), cfg.dtype)
+    return specs
+
+
+def _serve_state_vars(cfg, slots, src_len, max_len):
+    """Declare the serving-state vars (persistable: the executor reads
+    them from the engine's scope and donates their buffers) in the
+    current program."""
+    block = fluid.default_main_program().global_block()
+    out = {}
+    for name, (shape, dtype) in serving_state_specs(
+            cfg, slots, src_len, max_len).items():
+        out[name] = block.create_var(
+            name=name, shape=list(shape), dtype=dtype, persistable=True,
+            stop_gradient=True)
+    return out
+
+
+def build_prefill(cfg: Optional[TransformerConfig] = None, slots: int = 4,
+                  src_len: int = 32, max_len: int = 32, bos_id: int = 0):
+    """Admission program: encode one request and install it into a slot.
+
+    Feeds: src_ids [1, src_len] int64, src_pad_mask [1, src_len] f32,
+    slot [1] int64 (the batch slot this request occupies). Writes the
+    slot's cross-attention K/V + bias rows and resets its decode state
+    (cur=BOS at position 0, live). No fetches — admission is a pure
+    device-state update."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    cfg = cfg or base()
+    if src_len > cfg.max_length or max_len > cfg.max_length:
+        raise ValueError(
+            f"src_len/max_len ({src_len}/{max_len}) exceed the position "
+            f"table (max_length={cfg.max_length})")
+    src = layers.data("src_ids", shape=[src_len], dtype="int64")
+    src_pad = layers.data("src_pad_mask", shape=[src_len], dtype="float32")
+    slot = layers.data("slot", shape=[1], dtype="int64",
+                       append_batch_size=False)
+    state = _serve_state_vars(cfg, slots, src_len, max_len)
+    helper = LayerHelper("prefill")
+
+    def _slot_update(cache_var, value):
+        # cache[slot] = value (scalar slot index: the dynamic_update op)
+        out = helper.create_variable_for_type_inference(cache_var.dtype,
+                                                        True)
+        helper.append_op(
+            "dynamic_update",
+            inputs={"X": cache_var, "Index": slot, "Value": value},
+            outputs={"Out": out})
+        layers.assign(out, output=cache_var)
+
+    enc, enc_bias = _encode_source(src, src_pad, cfg)  # [1, s, d]
+    h, dh = cfg.n_head, cfg.d_head
+    for i in range(cfg.n_layer):
+        # cross-attention K/V projected ONCE per request at admission
+        # (build_decode recomputes them from enc every step)
+        k = _fc(enc, cfg.d_model, f"dec{i}_cross_k", "colp")
+        v = _fc(enc, cfg.d_model, f"dec{i}_cross_v", "colp")
+        # [1, s, d] -> [s, h, dh] (batch is literally 1 at admission)
+        k = layers.reshape(k, [-1, h, dh])
+        v = layers.reshape(v, [-1, h, dh])
+        _slot_update(state[f"serve_ck{i}"], k)
+        _slot_update(state[f"serve_cv{i}"], v)
+    _slot_update(state["serve_cross_bias"],
+                 layers.reshape(enc_bias, [1, 1, -1]))  # [1, 1, s] row
+    # slot decode state: BOS at position 0, live
+    _scatter_reset = [
+        ("serve_cur_ids", layers.fill_constant([1], "int64",
+                                               float(bos_id))),
+        ("serve_pos", layers.fill_constant([1], "int64", 0.0)),
+        ("serve_live", layers.fill_constant([1], "bool", 1.0)),
+    ]
+    for name, updates in _scatter_reset:
+        new = layers.scatter(state[name], slot, updates)
+        layers.assign(new, output=state[name])
+    return {"feeds": [src, src_pad, slot], "state": state, "config": cfg}
+
+
+def build_decode_step(cfg: Optional[TransformerConfig] = None,
+                      slots: int = 4, src_len: int = 32, max_len: int = 32,
+                      end_id: int = 1):
+    """One greedy decode token for every slot, against the on-device KV
+    cache. Feed: active_mask [slots] bool (host-side admission/eviction
+    control — a slot the host has evicted decodes as dead whatever the
+    device live flag says). Fetches: emitted token [slots] int64, live
+    [slots] bool (False = finished: EOS or length cap), position
+    [slots] int64 of the emitted token."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    cfg = cfg or base()
+    d, h, dh = cfg.d_model, cfg.n_head, cfg.d_head
+    active = layers.data("active_mask", shape=[slots], dtype="bool",
+                         append_batch_size=False)
+    state = _serve_state_vars(cfg, slots, src_len, max_len)
+    cur, pos, live = (state["serve_cur_ids"], state["serve_pos"],
+                      state["serve_live"])
+    helper = LayerHelper("decode_step")
+
+    # embed each slot's current token at its own position (the training
+    # graph's _embed, with position_ids replaced by the per-slot pos)
+    emb = layers.embedding(
+        layers.unsqueeze(cur, [1]), size=[cfg.trg_vocab_size, d],
+        param_attr=ParamAttr(
+            name="trg_emb.w",
+            initializer=fluid.initializer.NormalInitializer(
+                0.0, cfg.d_model ** -0.5)))
+    emb = layers.scale(emb, scale=d ** 0.5)
+    pemb = layers.embedding(
+        layers.unsqueeze(pos, [1]), size=[cfg.max_length, d],
+        param_attr=ParamAttr(
+            name="trg_pos.w",
+            initializer=fluid.initializer.NumpyArrayInitializer(
+                _positional_encoding(cfg.max_length, cfg.d_model)),
+            trainable=False))
+    x = layers.elementwise_add(emb, pemb)  # [S, 1, d]
+
+    # per-slot causal bias over the self-attention cache: position j
+    # visible iff j <= pos[s] (stale rows from a slot's previous
+    # occupant sit above pos and stay masked)
+    step_bias = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("kv_step_bias", inputs={"Pos": pos},
+                     outputs={"Out": step_bias},
+                     attrs={"length": int(max_len)})
+
+    def split_heads(z):
+        return layers.reshape(z, [0, 0, h, dh])
+
+    def cache_append(cache_var, row):
+        # cache[s, pos[s]] = row[s] — then attend the UPDATED cache so
+        # the current token sees its own K/V (full-prefix semantics)
+        out = helper.create_variable_for_type_inference(cache_var.dtype,
+                                                        True)
+        helper.append_op("kv_cache_write",
+                         inputs={"Cache": cache_var, "New": row,
+                                 "Pos": pos},
+                         outputs={"Out": out})
+        layers.assign(out, output=cache_var)
+        return out
+
+    for i in range(cfg.n_layer):
+        p = f"dec{i}"
+        # self-attention against the slot's KV ring
+        ln_x = _ln(x, f"{p}_preself")
+        q = split_heads(_fc(ln_x, d, f"{p}_self_q", "colp"))
+        kc = cache_append(state[f"serve_k{i}"],
+                          split_heads(_fc(ln_x, d, f"{p}_self_k", "colp")))
+        vc = cache_append(state[f"serve_v{i}"],
+                          split_heads(_fc(ln_x, d, f"{p}_self_v", "colp")))
+        ctx = _w_sdpa(q, kc, vc, step_bias, cfg, True)
+        attn = _fc(layers.reshape(ctx, [0, 0, d]), d, f"{p}_self_out",
+                   "rowp")
+        x = layers.elementwise_add(attn, x)
+        # cross-attention against the prefill-cached encoder K/V
+        ln_x = _ln(x, f"{p}_precross")
+        q = split_heads(_fc(ln_x, d, f"{p}_cross_q", "colp"))
+        ctx = _w_sdpa(q, state[f"serve_ck{i}"], state[f"serve_cv{i}"],
+                      state["serve_cross_bias"], cfg, True)
+        cross = _fc(layers.reshape(ctx, [0, 0, d]), d, f"{p}_cross_out",
+                    "rowp")
+        x = layers.elementwise_add(cross, x)
+        ff = _ffn(_ln(x, f"{p}_preffn"), cfg, p, True)
+        x = layers.elementwise_add(ff, x)
+    x = _ln(x, "dec_post")
+    logits = layers.fc(
+        x, cfg.trg_vocab_size, num_flatten_dims=2,
+        param_attr=ParamAttr(name="proj_colp.w"), bias_attr=False,
+    )
+    nxt = layers.argmax(layers.reshape(logits, [slots,
+                                                cfg.trg_vocab_size]),
+                        axis=-1)  # [S] int64, greedy
+
+    # liveness: host mask AND device EOS/length tracking. A dead slot
+    # freezes (emits end_id, position pinned) until the next prefill
+    # re-arms it.
+    end_const = layers.fill_constant([slots], "int64", float(end_id))
+    live_now = layers.logical_and(live, active)
+    emit = layers.where(live_now, nxt, end_const)
+    new_live = layers.logical_and(
+        live_now, layers.logical_not(layers.equal(emit, end_const)))
+    limit = layers.fill_constant([slots], "int64", float(max_len - 1))
+    new_live = layers.logical_and(new_live, layers.less_than(pos, limit))
+    emit_pos = layers.elementwise_add(
+        pos, layers.cast(live_now, "int64"))  # position the token holds
+    layers.assign(emit, output=cur)
+    layers.assign(emit_pos, output=pos)
+    layers.assign(new_live, output=live)
+    return {"feeds": [active], "emit": emit, "live": new_live,
+            "pos": emit_pos, "state": state, "config": cfg}
+
+
+_serving_prog_cache: Dict[tuple, dict] = {}
+
+
+def build_serving(cfg: TransformerConfig, slots: int, src_len: int,
+                  max_len: int, bos_id: int = 0, end_id: int = 1) -> dict:
+    """Build (or return cached) the serving program pair for this
+    (config, geometry). Engines sharing a geometry share program
+    OBJECTS — their executors' compile caches then key per scope, and
+    the persistent compile cache sees content-identical programs across
+    replicas (the warm-replica start path)."""
+    key = (
+        cfg.src_vocab_size, cfg.trg_vocab_size, cfg.d_model, cfg.d_inner,
+        cfg.n_head, cfg.n_layer, cfg.max_length, cfg.dtype,
+        slots, src_len, max_len, bos_id, end_id,
+    )
+    cached = _serving_prog_cache.get(key)
+    if cached is not None:
+        return cached
+    prefill_prog, decode_prog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prefill_prog, fluid.Program()):
+        prefill = build_prefill(cfg, slots=slots, src_len=src_len,
+                                max_len=max_len, bos_id=bos_id)
+    with fluid.program_guard(decode_prog, fluid.Program()):
+        decode = build_decode_step(cfg, slots=slots, src_len=src_len,
+                                   max_len=max_len, end_id=end_id)
+    entry = {
+        "prefill_program": prefill_prog, "prefill": prefill,
+        "decode_program": decode_prog, "decode": decode,
+        "state_specs": serving_state_specs(cfg, slots, src_len, max_len),
+        "config": cfg,
+    }
+    _serving_prog_cache[key] = entry
+    return entry
 
 
 def stack_weights_from_layers(cfg, per_layer_scope, scan_scope):

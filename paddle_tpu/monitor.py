@@ -1106,6 +1106,8 @@ ROUTES: Dict[str, str] = {
               "stragglers, OOM reports",
     "/profile": "JSON roofline plane: latest device profile per "
                 "program (top ops, verdict, measured MFU)",
+    "/serve": "JSON serving plane: per-engine slot/queue stats, token "
+              "throughput, TTFT + per-token latency quantiles",
 }
 
 
@@ -1216,6 +1218,14 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
                     from paddle_tpu import roofline as _roofline
 
                     body = json.dumps(_roofline.summary(),
+                                      sort_keys=True,
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif path == "/serve":
+                    # lazy import: serving.py imports monitor.py
+                    from paddle_tpu import serving as _serving
+
+                    body = json.dumps(_serving.summary(),
                                       sort_keys=True,
                                       default=str).encode()
                     ctype = "application/json"

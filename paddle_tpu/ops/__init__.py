@@ -23,6 +23,7 @@ from paddle_tpu.ops import (  # noqa: F401
     quant_ops,
     rnn_ops,
     sequence_ops,
+    serving_ops,
     sparse_ops,
     tensor_ops,
     vision_ops,

@@ -1,0 +1,64 @@
+"""Serving-plane ops: slot-indexed KV-cache maintenance for the
+continuous-batching decode path (serving.py, models/transformer.py
+``build_decode_step``).
+
+The reference framework serves autoregressive decode through per-request
+LoDTensor caches rebuilt op-by-op (reference: operators/
+tensor_array_read_write_op.cc driving the while-loop NMT decoder); here
+the cache is ONE dense device-resident tensor shared by every in-flight
+request — axis 0 is the batch *slot*, axis 1 the time position — so a
+single compiled single-token decode program serves a mixed bag of
+requests at different positions. Per-slot positions make the existing
+``dynamic_update`` (scalar index) insufficient: these ops take a
+``Pos [S]`` vector and scatter/mask per slot.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import register_op
+
+NEG_INF = -1e9
+
+
+@register_op("kv_cache_write", no_grad=True)
+def _kv_cache_write(ins, attrs):
+    """Write this step's K/V rows into the slot-indexed cache.
+
+    inputs:
+      Cache [S, T, ...]  — the persistable KV ring (slot-major)
+      New   [S, 1, ...]  — the freshly projected per-slot row
+      Pos   [S] int      — per-slot write position (clipped to T-1, so a
+                           frozen/dead slot rewriting its last position
+                           stays in bounds)
+    output: Out [S, T, ...] — cache with ``Out[s, Pos[s]] = New[s, 0]``.
+    """
+    cache = ins["Cache"][0]
+    new = ins["New"][0]
+    pos = ins["Pos"][0].astype(jnp.int32)
+    t = cache.shape[1]
+    pos = jnp.clip(pos, 0, t - 1)
+    s = cache.shape[0]
+    out = cache.at[jnp.arange(s), pos].set(
+        jnp.squeeze(new, axis=1).astype(cache.dtype))
+    return {"Out": [out]}
+
+
+@register_op("kv_step_bias", no_grad=True)
+def _kv_step_bias(ins, attrs):
+    """Per-slot additive attention bias over the KV cache: position j of
+    slot s is visible iff ``j <= Pos[s]`` (the causal prefix each
+    request has actually written; stale rows from a previous occupant of
+    the slot sit above ``Pos`` and stay masked).
+
+    inputs: Pos [S] int; attrs: length (the cache's T axis).
+    output: Out [S, 1, 1, T] float32 — 0 where visible, -1e9 elsewhere,
+    broadcastable against sdpa's [S, h, tq, T] logits like the pad
+    biases the training graph feeds.
+    """
+    pos = ins["Pos"][0].astype(jnp.int32)
+    t = int(attrs["length"])
+    vis = jnp.arange(t, dtype=jnp.int32)[None, :] <= pos[:, None]  # [S, T]
+    bias = jnp.where(vis, 0.0, NEG_INF).astype(jnp.float32)
+    return {"Out": [bias[:, None, None, :]]}

@@ -1,0 +1,558 @@
+"""Production inference serving plane: continuous batching over an
+on-device KV cache.
+
+Reference seam: the AnalysisPredictor C-API (inference.py) serves one
+request batch per call; real serving traffic is a stream of requests of
+different lengths arriving at different times. The reference framework
+dedicates its ``inference_transpiler``/server layer to this; here the
+serving plane is built on the pieces the training stack already proved:
+
+- **Continuous batch assembly**: a bounded request queue feeds a fixed
+  set of batch *slots*. Requests are admitted and evicted at token
+  boundaries — one compiled single-token decode executable serves every
+  mix of in-flight requests (no per-batch-shape recompiles, ever).
+- **Prefill/decode split** (models/transformer.py ``build_prefill`` /
+  ``build_decode_step``): admission runs the encoder once and writes the
+  request's cross-attention K/V into slot-indexed, device-resident cache
+  tensors; each decode step appends one self-attention K/V row per slot
+  and emits one greedy token per slot. The cache rides the executor's
+  donated-state path — it never round-trips through the host.
+- **Async decode loop**: decode steps dispatch with ``async_fetch``
+  (executor.LazyFetches), so step N's device->host token fetch
+  materializes under step N+1's dispatch — the serving twin of the
+  training pipeline's overlapped fetch.
+- **Warm replica start**: engines sharing a geometry share program
+  objects (transformer.build_serving), so the persistent compile cache
+  (``compile_cache_dir`` flag) resolves a fresh replica's prefill +
+  decode executables from disk — zero fresh XLA compiles at spin-up.
+- **SLO plane for free**: ``pt_serve_*`` metrics (queue depth, tokens/s,
+  TTFT + per-token latency histograms) ride the monitor registry; the
+  live endpoint serves an engine summary at ``/serve``; chaos plans can
+  arm ``serve.enqueue`` / ``serve.decode`` fault sites.
+
+Deployable artifacts: an engine loads weights from a live Scope, a
+Predictor, or a saved inference-model directory — including the int8 PTQ
+artifact (``slim/calibration.py``), whose weights deploy dequantized
+into the decode programs (weight-only int8: 4x smaller artifact, same
+serving surface).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu import faults as _faults
+from paddle_tpu import flags as _flags
+from paddle_tpu import monitor as _monitor
+from paddle_tpu.executor import Executor, Scope, scope_guard
+from paddle_tpu.framework import CPUPlace, TPUPlace
+
+# --- telemetry (no-ops while the 'telemetry' flag is off) ---
+
+_M_REQUESTS = _monitor.counter(
+    "pt_serve_requests_total",
+    "serving requests by terminal outcome (completed / length / "
+    "expired / rejected / drained / error)")
+_M_QUEUE_DEPTH = _monitor.gauge(
+    "pt_serve_queue_depth", "requests waiting for a batch slot")
+_M_SLOTS_ACTIVE = _monitor.gauge(
+    "pt_serve_slots_active", "batch slots holding an in-flight request")
+_M_PREFILLS = _monitor.counter(
+    "pt_serve_prefill_total", "admissions (prefill program runs)")
+_M_DECODE_STEPS = _monitor.counter(
+    "pt_serve_decode_steps_total",
+    "single-token decode steps (each serves every active slot)")
+_M_TOKENS = _monitor.counter(
+    "pt_serve_tokens_total", "tokens emitted across all requests")
+_M_TOKEN_SECONDS = _monitor.histogram(
+    "pt_serve_token_seconds",
+    "per-token latency (decode-step dispatch -> token on host)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5))
+_M_TTFT_SECONDS = _monitor.histogram(
+    "pt_serve_ttft_seconds",
+    "time to first token (request submit -> first token on host)",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             30.0))
+
+# chaos hooks (faults.py): a raise at serve.enqueue drills queue-path
+# failures, a delay/raise at serve.decode drills a stalled/failed decode
+# loop (the fault fires BEFORE the step dispatch, so device state stays
+# consistent and the engine can keep serving after the drill)
+_F_ENQUEUE = _faults.site("serve.enqueue")
+_F_DECODE = _faults.site("serve.decode")
+
+REQUEST_OUTCOMES = ("completed", "length", "expired", "rejected",
+                    "drained", "error")
+
+
+class QueueFull(RuntimeError):
+    """submit() backpressure: the request queue is at serve_queue_depth."""
+
+
+class EngineClosed(RuntimeError):
+    """submit()/step() on a closed engine."""
+
+
+class ServeRequest:
+    """One in-flight generation request (handle returned by submit)."""
+
+    # itertools.count: atomic under CPython — submit() is meant for
+    # concurrent callers and ids must stay unique across threads
+    _uid = itertools.count(1)
+
+    def __init__(self, src_ids, src_pad, max_new_tokens, deadline_s):
+        self.id = next(ServeRequest._uid)
+        self.src_ids = src_ids
+        self.src_pad = src_pad
+        self.max_new_tokens = max_new_tokens
+        self.submit_ts = time.perf_counter()
+        self.deadline_ts = (self.submit_ts + deadline_s
+                            if deadline_s else None)
+        self.tokens: List[int] = []
+        self.outcome: Optional[str] = None
+        self.ttft_s: Optional[float] = None
+        self._done = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the request reaches a terminal outcome; returns
+        the emitted tokens (EOS excluded)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} not finished within {timeout}s")
+        return list(self.tokens)
+
+    def _finish(self, outcome: str):
+        self.outcome = outcome
+        _M_REQUESTS.inc(labels={"outcome": outcome})
+        self._done.set()
+
+
+def _load_weights_into(scope: Scope, weights) -> bool:
+    """Install model weights into the engine's private scope. Accepts a
+    Scope (weights COPIED — donation would otherwise delete buffers the
+    source scope still references), a Predictor (its scope is the
+    source), or a saved inference-model directory (fp32 or int8 PTQ
+    artifact). Returns True when the int8 artifact path was taken."""
+    from paddle_tpu import inference as _inference
+
+    if isinstance(weights, _inference.Predictor):
+        weights = weights.scope
+    if isinstance(weights, Scope):
+        for name in weights.var_names():
+            scope.set(name, np.array(np.asarray(weights.find_var(name))))
+        return False
+    if isinstance(weights, str):
+        if os.path.exists(os.path.join(weights, "__params_int8__.npz")):
+            from paddle_tpu.slim.calibration import (
+                load_int8_inference_model,
+            )
+
+            load_int8_inference_model(weights, None, scope=scope)
+            return True
+        from paddle_tpu import io as _io
+
+        path = os.path.join(weights, _io._PARAMS_FILE)
+        with np.load(path) as data:
+            for name in data.files:
+                scope.set(name, np.asarray(data[name]))
+        return False
+    raise TypeError(
+        f"weights must be a Scope, Predictor or model dir, got "
+        f"{type(weights).__name__}")
+
+
+class _Slot:
+    """Host-side view of one batch slot."""
+
+    __slots__ = ("request",)
+
+    def __init__(self):
+        self.request: Optional[ServeRequest] = None
+
+
+class ServingEngine:
+    """Continuous-batching serving engine over the transformer zoo.
+
+    One engine = one model + one batch geometry: ``slots`` concurrent
+    requests, sources padded/bucketed to ``src_len``, at most
+    ``max_len - 1`` generated tokens per request. ``submit()`` enqueues
+    (with queue-depth backpressure and optional per-request deadlines);
+    the caller drives ``step()`` — or ``run_until_idle()`` — to make
+    progress; ``drain()`` stops admissions and finishes the in-flight
+    set; ``close()`` drains and releases the compiled entries.
+    """
+
+    def __init__(self, cfg, weights, *, slots: int = 4, src_len: int = 32,
+                 max_len: int = 32, bos_id: int = 0, end_id: int = 1,
+                 place=None, queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 pipeline_depth: int = 1):
+        from paddle_tpu.models import transformer as _T
+
+        if slots < 1:
+            raise ValueError("need at least one batch slot")
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.src_len, self.max_len = int(src_len), int(max_len)
+        self.bos_id, self.end_id = int(bos_id), int(end_id)
+        self.queue_depth = (int(_flags.get_flag("serve_queue_depth"))
+                            if queue_depth is None else int(queue_depth))
+        default_deadline = (float(_flags.get_flag("serve_deadline_ms"))
+                            if deadline_ms is None else float(deadline_ms))
+        self.deadline_s = default_deadline / 1e3 if default_deadline else 0.0
+        # 1 = double-buffered decode (step N's fetch materializes under
+        # step N+1's dispatch); 0 = fully synchronous steps
+        self.pipeline_depth = 1 if pipeline_depth else 0
+        self._progs = _T.build_serving(cfg, self.slots, self.src_len,
+                                       self.max_len, bos_id=self.bos_id,
+                                       end_id=self.end_id)
+        self.scope = Scope()
+        self._exe = Executor(place if place is not None else CPUPlace()
+                             if not _is_tpu_default() else TPUPlace(0))
+        self.int8 = _load_weights_into(self.scope, weights)
+        # device-resident serving state, zero-initialized (live=False
+        # everywhere: every slot starts free)
+        for name, (shape, dtype) in self._progs["state_specs"].items():
+            self.scope.set(name, np.zeros(shape, dtype=np.dtype(dtype)))
+        self._queue: "collections.deque[ServeRequest]" = collections.deque()
+        self._slots = [_Slot() for _ in range(self.slots)]
+        self._pending = None  # (LazyFetches, per-slot request snapshot, t0)
+        self._lock = threading.Lock()
+        self._draining = False
+        self._closed = False
+        self.decode_steps = 0
+        self.tokens_emitted = 0
+        self.completed = 0
+        _ENGINES.add(self)
+
+    # --- request intake ---
+
+    def submit(self, src_ids: Sequence[int],
+               src_pad: Optional[Sequence[float]] = None,
+               max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> ServeRequest:
+        """Enqueue a generation request. ``src_ids`` shorter than the
+        engine's ``src_len`` is padded (mask derived); longer raises.
+        Backpressure: raises QueueFull beyond ``serve_queue_depth``."""
+        _F_ENQUEUE.hit()
+        ids = np.asarray(src_ids, np.int64).reshape(-1)
+        if ids.shape[0] > self.src_len:
+            raise ValueError(
+                f"source length {ids.shape[0]} exceeds the engine's "
+                f"src_len {self.src_len}")
+        if src_pad is None:
+            pad = (np.arange(self.src_len) < ids.shape[0]).astype(
+                np.float32)
+        else:
+            # accepted at either the request's own length or the
+            # engine's full src_len (the training graph's mask shape)
+            mask = np.asarray(src_pad, np.float32).reshape(-1)
+            if mask.shape[0] == self.src_len:
+                pad = mask
+            elif mask.shape[0] == ids.shape[0]:
+                pad = np.zeros(self.src_len, np.float32)
+                pad[:ids.shape[0]] = mask
+            else:
+                raise ValueError(
+                    f"src_pad length {mask.shape[0]} matches neither "
+                    f"the source length {ids.shape[0]} nor the "
+                    f"engine's src_len {self.src_len}")
+        full = np.zeros(self.src_len, np.int64)
+        full[:ids.shape[0]] = ids
+        cap = self.max_len - 1
+        if max_new_tokens is not None and int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        want = cap if max_new_tokens is None else min(int(max_new_tokens),
+                                                     cap)
+        deadline_s = (self.deadline_s if deadline_ms is None
+                      else float(deadline_ms) / 1e3)
+        req = ServeRequest(full, pad, want, deadline_s)
+        with self._lock:
+            # closed/draining re-checked under the SAME lock drain()
+            # clears the queue with: a submit racing a drain must either
+            # land before the sweep or raise, never enqueue onto an
+            # engine nobody will step again
+            if self._closed:
+                raise EngineClosed("submit() on a closed engine")
+            if self._draining:
+                raise EngineClosed("submit() on a draining engine")
+            if len(self._queue) >= self.queue_depth:
+                req._finish("rejected")
+                _publish_gauges()
+                raise QueueFull(
+                    f"serving queue at capacity ({self.queue_depth})")
+            self._queue.append(req)
+            _publish_gauges()
+        return req
+
+    # --- the scheduler tick ---
+
+    def step(self) -> int:
+        """One scheduler tick: resolve the previously dispatched decode
+        step (handing tokens to their requests and freeing finished
+        slots), admit queued requests into free slots (prefill), and
+        dispatch the next single-token decode step. Returns the number
+        of tokens handed out this tick."""
+        if self._closed:
+            raise EngineClosed("step() on a closed engine")
+        emitted = self._process_ready()
+        self._admit()
+        self._dispatch()
+        if self.pipeline_depth == 0:
+            emitted += self._process_ready()
+        return emitted
+
+    def run_until_idle(self, max_steps: int = 100_000) -> int:
+        """Drive step() until no request is queued or in flight; returns
+        total tokens emitted. ``max_steps`` bounds a runaway loop."""
+        total = 0
+        for _ in range(max_steps):
+            total += self.step()
+            if not self.busy():
+                break
+        # resolve a still-pending final step
+        total += self._process_ready()
+        return total
+
+    def busy(self) -> bool:
+        with self._lock:
+            queued = bool(self._queue)
+        return (queued or self._pending is not None
+                or any(s.request is not None for s in self._slots))
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain: stop admissions, finish the in-flight set.
+        Queued-but-unadmitted requests finish with outcome 'drained'.
+        Returns True when everything settled inside ``timeout_s``."""
+        with self._lock:
+            # flag + queue sweep under one lock: a racing submit either
+            # landed (and is drained here) or raises EngineClosed
+            self._draining = True
+            while self._queue:
+                self._queue.popleft()._finish("drained")
+            _publish_gauges()
+        t0 = time.perf_counter()
+        while self.busy():
+            self.step()
+            if time.perf_counter() - t0 > timeout_s:
+                return False
+        return True
+
+    def close(self, drain_timeout_s: float = 30.0):
+        """Drain, then release the engine's compiled entries + staged
+        feeds and its device-resident state. A drain that times out
+        (stalled decode loop) must not strand callers: every still
+        in-flight handle is finished with outcome 'drained' (partial
+        output kept) so ``result()`` never blocks forever on a closed
+        engine."""
+        if self._closed:
+            return
+        self.drain(drain_timeout_s)
+        self._closed = True
+        self._pending = None
+        for s in self._slots:
+            req, s.request = s.request, None
+            if req is not None and req.outcome is None:
+                req._finish("drained")
+        self._exe.release_scope(self.scope)
+        self.scope.clear()
+        _ENGINES.discard(self)
+        _publish_gauges()
+
+    # --- internals ---
+
+    def _active_mask(self) -> np.ndarray:
+        return np.asarray(
+            [s.request is not None and s.request.outcome is None
+             for s in self._slots], bool)
+
+    def _admit(self):
+        """Admissions at the token boundary: free slot x queued request
+        -> prefill. The prefill program executes after the already
+        dispatched decode step, so the newcomer joins at the next one."""
+        while True:
+            free = next((i for i, s in enumerate(self._slots)
+                         if s.request is None), None)
+            if free is None:
+                return
+            with self._lock:
+                if not self._queue:
+                    return
+                req = self._queue.popleft()
+                _publish_gauges()
+            if (req.deadline_ts is not None
+                    and time.perf_counter() > req.deadline_ts):
+                req._finish("expired")
+                continue
+            pre = self._progs["prefill"]
+            try:
+                with scope_guard(self.scope), \
+                        _monitor.span("serve.prefill"):
+                    self._exe.run(
+                        self._progs["prefill_program"],
+                        feed={
+                            pre["feeds"][0].name: req.src_ids[None, :],
+                            pre["feeds"][1].name: req.src_pad[None, :],
+                            pre["feeds"][2].name:
+                                np.asarray([free], np.int64),
+                        },
+                        fetch_list=[])
+            except Exception:
+                # the request is already off the queue and owns no slot:
+                # finish the handle before propagating — result() must
+                # never block forever on a failed admission
+                req._finish("error")
+                raise
+            self._slots[free].request = req
+            _M_PREFILLS.inc()
+            _publish_gauges()
+
+    def _dispatch(self):
+        """Launch one single-token decode step for the active set (a
+        no-op tick when every slot is free)."""
+        mask = self._active_mask()
+        if not mask.any():
+            return
+        _F_DECODE.hit()
+        dec = self._progs["decode"]
+        t0 = time.perf_counter()
+        with scope_guard(self.scope), _monitor.span("serve.decode"):
+            fetches = self._exe.run(
+                self._progs["decode_program"],
+                feed={dec["feeds"][0].name: mask},
+                fetch_list=[dec["emit"], dec["live"], dec["pos"]],
+                async_fetch=True)
+        snapshot = [s.request if m else None
+                    for s, m in zip(self._slots, mask)]
+        self._pending = (fetches, snapshot, t0)
+        self.decode_steps += 1
+        _M_DECODE_STEPS.inc()
+
+    def _process_ready(self) -> int:
+        """Materialize the pending decode step's fetches and hand each
+        slot's token to its request; evict finished/expired requests
+        (their slots free for the next admission round)."""
+        if self._pending is None:
+            return 0
+        fetches, snapshot, t0 = self._pending
+        self._pending = None
+        emit, live, pos = [np.asarray(a) for a in fetches]
+        now = time.perf_counter()
+        step_s = now - t0
+        emitted = 0
+        for i, req in enumerate(snapshot):
+            if req is None or req.outcome is not None:
+                continue
+            tok = int(emit[i])
+            alive = bool(live[i])
+            if not alive and tok == self.end_id:
+                # EOS (or a dead-slot freeze): terminal, token dropped
+                self._finish_slot(i, req, "completed")
+                continue
+            req.tokens.append(tok)
+            emitted += 1
+            self.tokens_emitted += 1
+            _M_TOKENS.inc()
+            _M_TOKEN_SECONDS.observe(step_s)
+            if req.ttft_s is None:
+                req.ttft_s = now - req.submit_ts
+                _M_TTFT_SECONDS.observe(req.ttft_s)
+            if not alive or len(req.tokens) >= req.max_new_tokens:
+                # device length cap (max_len positions) or the request's
+                # own token budget: terminal without an EOS
+                self._finish_slot(i, req, "length")
+            elif (req.deadline_ts is not None and now > req.deadline_ts):
+                # deadline eviction AT the token boundary: the slot is
+                # freed now; the partial output stays on the handle
+                self._finish_slot(i, req, "expired")
+        _publish_gauges()
+        return emitted
+
+    def _finish_slot(self, i: int, req: ServeRequest, outcome: str):
+        req._finish(outcome)
+        self.completed += 1
+        self._slots[i].request = None
+
+    def stats(self) -> Dict:
+        """One JSON-able row for the /serve route."""
+        with self._lock:
+            queued = len(self._queue)
+        return {
+            "slots": self.slots,
+            "slots_active": int(self._active_mask().sum()),
+            "queue_depth": queued,
+            "queue_capacity": self.queue_depth,
+            "src_len": self.src_len,
+            "max_len": self.max_len,
+            "decode_steps": self.decode_steps,
+            "tokens_emitted": self.tokens_emitted,
+            "requests_completed": self.completed,
+            "draining": self._draining,
+            "int8": self.int8,
+            "pipeline_depth": self.pipeline_depth,
+        }
+
+
+def _is_tpu_default() -> bool:
+    import jax
+
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+_ENGINES: "weakref.WeakSet[ServingEngine]" = weakref.WeakSet()
+
+
+def _publish_gauges():
+    """Refresh the process-wide queue/slot gauges as SUMS across live
+    engines — per-engine .set() calls would let an idle engine zero out
+    a saturated neighbor's reading (the per-engine split lives in
+    /serve's stats rows)."""
+    engines = list(_ENGINES)
+    _M_QUEUE_DEPTH.set(sum(len(e._queue) for e in engines))
+    _M_SLOTS_ACTIVE.set(sum(
+        1 for e in engines for s in e._slots
+        if s.request is not None and s.request.outcome is None))
+
+
+def serve(cfg, weights, **kwargs) -> ServingEngine:
+    """Predictor-style front end: build a ServingEngine over ``weights``
+    (a Scope, a Predictor, or a saved inference-model directory — the
+    int8 PTQ artifact deploys dequantized). See ServingEngine for the
+    geometry/SLO knobs."""
+    return ServingEngine(cfg, weights, **kwargs)
+
+
+def summary() -> Dict:
+    """The /serve route payload: one stats row per live engine."""
+    engines = [e.stats() for e in list(_ENGINES)]
+    return {
+        "engines": engines,
+        "engine_count": len(engines),
+        "tokens_total": int(_M_TOKENS.value()),
+        "decode_steps_total": int(_M_DECODE_STEPS.value()),
+        "token_latency_s": {
+            label: _M_TOKEN_SECONDS.quantile(q)
+            for label, q in _monitor.QUANTILE_LABELS
+        },
+        "ttft_s": {
+            label: _M_TTFT_SECONDS.quantile(q)
+            for label, q in _monitor.QUANTILE_LABELS
+        },
+    }

@@ -76,6 +76,12 @@ def pytest_configure(config):
         "from every default tier, run with -m chaos")
     config.addinivalue_line(
         "markers",
+        "serving_e2e: serving-plane end-to-end drill at full slot "
+        "counts (continuous batching vs solo-decode parity); the "
+        "heavyweight ones also carry 'slow' — select the family with "
+        "-m serving_e2e")
+    config.addinivalue_line(
+        "markers",
         "multidevice_fragile: quarantined under the environment's glibc "
         "heap-corruption crash (seeded by 8-device pjit executions; "
         "reproduces at the seed tree — see ROADMAP watch item). The "

@@ -91,6 +91,81 @@ def test_predictor_warmup_and_run_batch(saved_model):
     assert len(pred._exe._cache) == 1
 
 
+def test_predictor_close_releases_entries_and_blocks_run(saved_model):
+    """close() releases the predictor's compiled entries + its scope
+    (mirroring Executor.close scoped to this predictor) and a later run
+    raises instead of recompiling against a cleared scope."""
+    d, xv, ref = saved_model
+    pred = inference.create_predictor(inference.Config(d).disable_tpu())
+    (out,) = pred.run([xv])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    assert len(pred._exe._cache) == 1
+    assert len(pred.scope.var_names()) > 0
+    pred.close()
+    assert len(pred._exe._cache) == 0
+    assert pred.scope.var_names() == []
+    with pytest.raises(RuntimeError, match="close"):
+        pred.run([xv])
+    pred.close()  # idempotent
+
+
+def test_release_scope_drops_only_that_scope_entries(saved_model):
+    """Executor.release_scope is per-tenant: two predictor-style scopes
+    through ONE executor; retiring one must not cold-start the other."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, monitor
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        y = layers.softmax(layers.fc(x, 2))
+    exe = fluid.Executor(fluid.CPUPlace())
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    xv = np.ones((2, 4), np.float32)
+    for s in (s1, s2):
+        with fluid.scope_guard(s):
+            exe.run(startup)
+            exe.run(main, feed={"x": xv}, fetch_list=[y])
+    n0 = len(exe._cache)
+    assert exe.release_scope(s1) >= 1
+    assert len(exe._cache) < n0
+    # the survivor still hits: no fresh compile for scope 2
+    misses0 = monitor.counter("pt_executor_cache_misses_total").value()
+    with fluid.scope_guard(s2):
+        exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert monitor.counter(
+        "pt_executor_cache_misses_total").value() == misses0
+
+
+def test_batch_bucketing_bounds_compiled_shapes(saved_model):
+    """set_batch_buckets: a randomized batch-size sweep must compile at
+    most one executable per bucket (today's alternative: one per
+    observed size) while matching the exact-shape outputs."""
+    d, xv, _ = saved_model
+    exact = inference.create_predictor(inference.Config(d).disable_tpu())
+    pred = inference.create_predictor(
+        inference.Config(d).disable_tpu().set_batch_buckets([2, 4, 8]))
+    rng = np.random.RandomState(7)
+    sizes = list(rng.randint(1, 11, size=12)) + [1, 10, 8, 3]
+    for n in sizes:
+        x = rng.randn(int(n), 16).astype(np.float32)
+        (out,) = pred.run([x])
+        assert out.shape[0] == n
+        (want,) = exact.run([x])
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    # the whole sweep compiled at most len(buckets) executables
+    assert len(pred._exe._cache) <= 3
+    # the exact-shape predictor compiled one per observed size
+    assert len(exact._exe._cache) == len({int(n) for n in sizes})
+
+
+def test_batch_bucket_validation():
+    with pytest.raises(ValueError, match="positive"):
+        inference.Config("x").set_batch_buckets([0, 2])
+    with pytest.raises(ValueError, match="positive"):
+        inference.Config("x").set_batch_buckets([])
+
+
 @pytest.mark.full
 def test_zoo_export_predictor_parity(tmp_path):
     """Every zoo family round-trips save_inference_model -> Predictor

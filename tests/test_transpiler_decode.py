@@ -1,0 +1,100 @@
+"""InferenceTranspiler on the transformer DECODE path (previously only
+covered on conv+BN training clones): the pass must be a verified no-op
+on the pruned beam-decode program — zero folds, no version bump, greedy
+decode token-identical before/after — and equally inert on the serving
+prefill/decode-step pair, whose programs are shared module-cache objects
+a rewriting pass must not silently mutate."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import serving
+from paddle_tpu.models import transformer as T
+from paddle_tpu.transpiler import InferenceTranspiler
+
+BOS, EOS = 0, 1
+
+
+def tiny_cfg():
+    return T.TransformerConfig(
+        src_vocab_size=37, trg_vocab_size=41, max_length=64,
+        d_model=16, d_inner=32, n_head=2, n_layer=1,
+        dropout=0.0, label_smooth_eps=0.0,
+    )
+
+
+@pytest.fixture(scope="module")
+def trained():
+    cfg = tiny_cfg()
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        T.build(cfg, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return cfg, scope, exe
+
+
+def _greedy(cfg, scope, exe, prog, dec, src, src_pad):
+    with fluid.scope_guard(scope):
+        ids, scores = exe.run(
+            prog, feed={"src_ids": src, "src_pad_mask": src_pad},
+            fetch_list=[dec["ids"], dec["scores"]])
+    return np.asarray(ids), np.asarray(scores)
+
+
+def test_transpile_decode_program_is_verified_noop(trained):
+    """The decode program has no conv+BN chains: transpile must report
+    zero folds, leave the program version alone (a gratuitous bump would
+    recompile every cached decode executable), and greedy output must be
+    bit-identical before/after."""
+    cfg, scope, exe = trained
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        dec = T.build_decode(cfg, beam_size=1, max_len=6, src_len=5,
+                             bos_id=BOS, end_id=EOS)
+    r = np.random.RandomState(0)
+    src = r.randint(2, 37, (2, 5)).astype(np.int64)
+    src_pad = np.ones((2, 5), np.float32)
+
+    ids_before, scores_before = _greedy(cfg, scope, exe, prog, dec,
+                                        src, src_pad)
+    version = prog.version
+    n_ops = len(prog.global_block().ops)
+
+    folded = InferenceTranspiler().transpile(prog, scope)
+    assert folded == 0
+    assert prog.version == version  # no-op must not invalidate caches
+    assert len(prog.global_block().ops) == n_ops
+
+    ids_after, scores_after = _greedy(cfg, scope, exe, prog, dec,
+                                      src, src_pad)
+    np.testing.assert_array_equal(ids_before, ids_after)
+    np.testing.assert_array_equal(scores_before, scores_after)
+
+
+def test_transpile_serving_programs_and_decode_unchanged(trained):
+    """Running the pass over the serving prefill/decode-step programs
+    (engine-shared objects) must fold nothing and leave the engine's
+    greedy stream identical."""
+    cfg, scope, exe = trained
+
+    def decode_stream():
+        eng = serving.ServingEngine(cfg, scope, slots=2, src_len=8,
+                                    max_len=8, bos_id=BOS, end_id=EOS)
+        reqs = [eng.submit([5, 6, 7]), eng.submit([9, 4, 11, 2])]
+        eng.run_until_idle()
+        out = [list(q.tokens) for q in reqs]
+        eng.close()
+        return out
+
+    before = decode_stream()
+    progs = T.build_serving(cfg, 2, 8, 8, bos_id=BOS, end_id=EOS)
+    for key in ("prefill_program", "decode_program"):
+        prog = progs[key]
+        version = prog.version
+        assert InferenceTranspiler().transpile(prog, scope) == 0
+        assert prog.version == version
+    assert decode_stream() == before
