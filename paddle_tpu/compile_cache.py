@@ -29,8 +29,19 @@ Key composition — an entry is addressed by a sha256 digest over:
   PRNG key aval               the key dtype encodes the ``prng_impl``
   window shape                run_steps: (n_feeds, steps) — ``steps`` is a
                               static argument baked into the executable
-  environment token           jax/jaxlib versions, backend, device count +
-                              kind, process count, cache format version
+  environment token           jax/jaxlib versions, backend, cache format
+                              version (process-independent)
+  owning-shard topology       local executables: (sorted addressable
+                              device ids, kind) — the ids, NOT a count:
+                              the serialized executable bakes an XLA
+                              device assignment, and two ranks of a
+                              distributed world share a count but not
+                              ids. Excludes process/world counts, so a
+                              resize never cold-starts a process whose
+                              device identity is unchanged; SPMD
+                              executables: (process index, process
+                              count, global device count, kind) — one
+                              entry per program shard
   ==========================  ==============================================
 
 Entries are written atomically (stage + fsync + rename — the checkpoint
@@ -299,18 +310,68 @@ def fingerprint_for(ident: tuple, program, compiled=None, strategy=None,
 
 
 def env_token() -> tuple:
-    """Everything about the process that an executable bakes in: a
-    mismatch on any component means the disk entry is not ours to load."""
+    """The process-independent half of what an executable bakes in: a
+    mismatch on any component means the disk entry is not ours to load.
+    The device/process half lives in ``topology_token`` (keyed by the
+    OWNING shard, not the global world — the property that lets a
+    joining host of a resized world warm-start from a smaller
+    generation's entries)."""
     import jaxlib
 
-    try:
-        devs = jax.devices()
-        kind = getattr(devs[0], "device_kind", "?")
-        n = len(devs)
-    except Exception:
-        kind, n = "?", 0
     return (FORMAT_VERSION, jax.__version__, jaxlib.__version__,
-            jax.default_backend(), n, str(kind), jax.process_count())
+            jax.default_backend())
+
+
+def topology_token(state_vals=(), mesh=None, extra_devices=()) -> tuple:
+    """Owning-shard topology token — the multi-host half of the entry
+    key (ISSUE 14: replaces the blanket ``process_count() > 1``
+    decline).
+
+    An executable whose referenced devices (state array shardings, the
+    strategy mesh) are all ADDRESSABLE by this process is **local**:
+    its token is ``("local", sorted addressable device ids, kind)``.
+    The serialized executable bakes an XLA device assignment, so it is
+    loadable exactly where its device ids are addressable — the ids ARE
+    the owning-shard identity (two ranks of a distributed world have
+    distinct local ids and therefore distinct entries; the same rank
+    across generations, or any single-process world, shares). The token
+    deliberately excludes the process count and the global device
+    count, so a world RESIZE does not cold-start processes whose device
+    identity is unchanged — what lets a generation-N+1 member
+    warm-start from generation N's store.
+
+    An executable that spans non-addressable devices is a per-process
+    shard of an SPMD program: its token is ``("spmd", process index,
+    process count, global device count, kind)`` — the owning shard's
+    identity, so rank 3's serialized executable can never resolve as
+    rank 5's, and a replacement host joining at index 3 resolves
+    exactly its predecessor shard's entry."""
+    devs = set(extra_devices)
+    for v in state_vals:
+        if isinstance(v, jax.Array):
+            try:
+                devs |= set(v.sharding.device_set)
+            except Exception:
+                pass
+    if mesh is not None:
+        try:
+            devs |= set(np.asarray(mesh.devices).flat)
+        except Exception:
+            pass
+    try:
+        local = set(jax.local_devices())
+        kind = str(getattr(next(iter(local)), "device_kind", "?"))
+    except Exception:
+        local, kind = set(), "?"
+    if devs - local:
+        try:
+            n_global = len(jax.devices())
+        except Exception:
+            n_global = 0
+        return ("spmd", int(jax.process_index()),
+                int(jax.process_count()), n_global, kind)
+    ids = tuple(sorted(int(getattr(d, "id", -1)) for d in local))
+    return ("local", ids, kind)
 
 
 def _aval(v) -> tuple:
@@ -375,8 +436,6 @@ def executor_spec(program, *, feed_vals, fetch_names, scope, base_key,
         return None
     if fingerprint.startswith("local-"):
         return None  # content not canonical -> not portable across procs
-    if jax.process_count() > 1:
-        return None  # multi-host executables are per-process; out of scope
     try:
         from paddle_tpu.core.lowering import analyze_state
 
@@ -389,11 +448,18 @@ def executor_spec(program, *, feed_vals, fetch_names, scope, base_key,
                 return None  # the run itself will raise the real error
             state[n] = v
         state_sig = tuple((n, _aval(v)) for n, v in state.items())
+        # the owning-shard topology token rides the digest: local
+        # executables share entries across ranks/world sizes, SPMD
+        # executables are keyed per process shard (ISSUE 14 — what used
+        # to be a blanket multi-host decline)
+        topo = topology_token(
+            list(state.values()) + list(feed_vals.values()),
+            getattr(compiled, "mesh", None))
         digest = hashlib.sha256(repr((
             fingerprint, state_sig, _aval(base_key),
             None if window_steps is None else (int(n_feeds or 0),
                                                int(window_steps)),
-            bool(nan_track), env_token(),
+            bool(nan_track), env_token(), topo,
         )).encode()).hexdigest()
         if window_steps is None:
             lower_args: tuple = (state, dict(feed_vals), base_key,

@@ -150,7 +150,11 @@ class Trainer:
             self.train_outputs = list(outs)
             self.loss = self.train_outputs[0]
             self.test_program = self.main_program.clone(for_test=True)
-            optimizer_func().minimize(self.loss)
+            # keep the instance: its slot_descriptor() is what lets a
+            # resume re-key saved moments onto THIS build's slot names
+            # (checkpoint.reshard_optimizer_state)
+            self._optimizer = optimizer_func()
+            self._optimizer.minimize(self.loss)
         if _numerics.active():
             # numerics plane on at build time: instrument the train
             # program so every trainer step feeds tensor stats + NaN
@@ -200,6 +204,18 @@ class Trainer:
         if loaded is None:
             return None
         step, values = loaded
+        # a second manifest read, deliberately: the fragments are KBs
+        # of JSON (no array data) and resume is a rare event — not
+        # worth widening load_latest's return shape for
+        saved_slots = _ckpt.manifest_slots(cfg.checkpoint_dir, step)
+        if saved_slots and self._optimizer is not None:
+            # optimizer slot state restores by (param, kind), not by
+            # name: a rebuilt/resized program's slot names drift with
+            # its unique-name counters, and a by-name restore would
+            # silently zero the moments (placement is left to the
+            # executor's in_shardings, like the parameters')
+            values = _ckpt.reshard_optimizer_state(
+                values, saved_slots, self._optimizer.slot_descriptor())
         for n, v in values.items():
             self.scope.set(n, v)
         names = set(values)
@@ -294,7 +310,9 @@ class Trainer:
         try:
             handle = _ckpt.save_scope(cfg.checkpoint_dir, self.scope,
                                       step=serial,
-                                      async_save=cfg.async_save)
+                                      async_save=cfg.async_save,
+                                      slots=self._optimizer
+                                      .slot_descriptor())
         finally:
             # safe even under async_save: the device->host snapshot is
             # materialized before save_scope returns, so the scope keys

@@ -159,6 +159,10 @@ BUILTIN_SITES = {
     "fleet.heartbeat": "worker heartbeat RPC (fleet_base)",
     "fleet.resize": "elastic-resize planning after dead-worker "
                     "detection (fleet_base.plan_resize)",
+    "fleet.join": "scale-out admission on the JOINER (fleet_base."
+                  "join_world): hit 1 = the announce, hit 2 = plan "
+                  "adoption — chaos plans can tear an admission at "
+                  "either seam",
     "executor.step": "executor step/window body, pre-dispatch "
                      "(executor.py; delay = a slowed rank for the fleet "
                      "straggler drill — the sleep lands in the dispatch "

@@ -23,11 +23,12 @@ data parallelism; per-step liveness goes through heartbeat/dead_workers
 from __future__ import annotations
 
 import atexit
+import json as _json
 import os as _os
 import re as _re
 import sys as _sys
 import time as _time
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
@@ -54,8 +55,13 @@ _M_DEAD_EVENTS = _monitor.counter(
     "barrier_or_dead returns that reported dead peers")
 _M_RESIZES = _monitor.counter(
     "pt_fleet_resizes_total",
-    "elastic world resizes: re-rendezvous of a shrunk world launched "
-    "after dead-worker detection")
+    "elastic world resizes launched (re-exec to generation N+1), by "
+    "direction: shrink = survivors of dead-worker detection, grow = a "
+    "world admitting announced joiners")
+_M_JOIN_SECONDS = _monitor.histogram(
+    "pt_fleet_join_seconds",
+    "scale-out admission latency on the JOINER: announce over the "
+    "running world's KV -> leader plan adopted + acked (join_world)")
 
 # chaos hooks: armed plans fail/delay the Nth coordination RPC, so the
 # retry policy's behavior is reproducibly testable (faults.py docstring)
@@ -64,11 +70,27 @@ _F_KV_GET = _faults.site("fleet.kv_get")
 _F_KV_PUT = _faults.site("fleet.kv_put")
 _F_HEARTBEAT = _faults.site("fleet.heartbeat")
 _F_RESIZE = _faults.site("fleet.resize")
+_F_JOIN = _faults.site("fleet.join")
+
+# join announcements live in numbered KV slots (fleet/join/g<gen>/<id>);
+# the probe scans this many — a resize event admitting more than 64
+# hosts at once should land as two resizes
+_JOIN_SLOT_CAP = 64
 
 # heartbeats are fired from poll loops — a few quick retries beat a long
 # backoff that would itself age the heartbeat past max_age_ms
 _HEARTBEAT_POLICY = _retry.RetryPolicy(
     base_delay=0.05, max_delay=0.5, max_attempts=3, retry_on=(OSError,))
+
+
+def resize_direction(spec: dict) -> str:
+    """The ``pt_fleet_resizes_total`` direction label for a
+    ``plan_resize`` spec: ``grow`` whenever the resize ADMITS joiners
+    (matching the metric's documented meaning — a composed replacement
+    resize that loses as many dead ranks as it admits is still an
+    admission event, and its join latency already metered), ``shrink``
+    otherwise."""
+    return "grow" if spec.get("joiners") else "shrink"
 
 
 def _barrier_label(name: str) -> str:
@@ -94,7 +116,17 @@ class Fleet:
              connect_timeout_ms: Optional[int] = None):
         """Rendezvous + distributed runtime init. Single-worker jobs
         (worker_num == 1) need no endpoints and become a no-op.
-        ``connect_timeout_ms`` defaults to the ``rpc_deadline_ms`` flag."""
+        ``connect_timeout_ms`` defaults to the ``rpc_deadline_ms`` flag.
+
+        ``PT_COORD_ONLY=1`` skips ``jax.distributed.initialize`` —
+        coordination-only fleets: the coord service, KV, barriers,
+        heartbeats, elastic resize and the commit barrier all come up,
+        but each process keeps its own single-process jax world. For
+        jobs whose compute is per-process (replicated smoke drills on
+        backends that cannot form a cross-process XLA world, host-side
+        parameter servers), and what gives every rank the SAME device
+        identity — the condition under which the persistent compile
+        cache's local entries are shareable fleet-wide."""
         if self._initialized:
             return self
         if connect_timeout_ms is None:
@@ -132,14 +164,21 @@ class Fleet:
                         connect_timeout_ms,
                     ).decode()
                 self._client.barrier("fleet/rendezvous", n)
+                if self._role.is_first_worker():
+                    # late joiners read the running world's generation
+                    # here before announcing (join_world); a world that
+                    # never published it is generation 0
+                    self.put("fleet/generation",
+                             str(self.generation()).encode())
 
-                import jax
+                if _os.environ.get("PT_COORD_ONLY") != "1":
+                    import jax
 
-                jax.distributed.initialize(
-                    jax_ep,
-                    num_processes=n,
-                    process_id=self._role.worker_index(),
-                )
+                    jax.distributed.initialize(
+                        jax_ep,
+                        num_processes=n,
+                        process_id=self._role.worker_index(),
+                    )
             _M_RENDEZVOUS.inc()
             # register with the fleet observability plane: the /fleet
             # route aggregates through this client (each worker also
@@ -364,7 +403,6 @@ class Fleet:
             from paddle_tpu import flags as _flags
 
             timeout_ms = _flags.get_flag("rpc_deadline_ms")
-        me = self.worker_index()
         gen = self.generation()
         cur = {str(d) for d in observed}
         stable = 0.0
@@ -383,35 +421,136 @@ class Fleet:
             if not survivors:
                 raise ValueError(
                     f"settle_dead: every rank is stale ({sorted(cur)})")
-            key = f"fleet/resize/dead/g{gen}"
-            if me == survivors[0]:
-                self.put(key, ",".join(sorted(cur)).encode())
-                dl = _retry.Deadline(timeout_ms / 1000.0)
-                for r in survivors[1:]:
-                    self.get(f"fleet/resize/ack/g{gen}/{r}",
-                             timeout_ms=max(1, dl.remaining_ms()))
-                return sorted(cur)
-            agreed = self.get(key, timeout_ms=timeout_ms).decode()
-            self.put(f"fleet/resize/ack/g{gen}/{me}", b"1")
-            return sorted(x for x in agreed.split(",") if x)
+            agreed = self._leader_adopt(
+                f"fleet/resize/dead/g{gen}",
+                f"fleet/resize/ack/g{gen}",
+                ",".join(sorted(cur)).encode(),
+                survivors[0], survivors[1:], timeout_ms)
+            return sorted(x for x in agreed.decode().split(",") if x)
 
-    def plan_resize(self, dead_ids: Sequence, rank: Optional[int] = None,
-                    world: Optional[int] = None) -> dict:
-        """Deterministic shrunk-world spec for a resize after
-        ``barrier_or_dead`` reported ``dead_ids`` (``worker-<r>`` ids or
-        plain ranks; pass them through ``settle_dead`` first so every
-        survivor plans from the SAME set). Every survivor derives the
-        identical plan from the same dead set — survivors keep their
-        relative rank order. Chaos plans can tear this step via the
-        ``fleet.resize`` site (a raise here models a survivor that
-        fails during the resize decision).
+    def pending_joins(self, known: Sequence[int] = ()) -> List[int]:
+        """Join ids announced against THIS generation: a non-blocking
+        probe of the numbered join slots (``fleet/join/g<gen>/<id>``,
+        ids 0..63). Incumbents poll this to notice newcomers; the
+        settle/plan flow (``settle_joins`` -> ``plan_resize(joins=)``)
+        turns the announcements into a grown world. Announcements never
+        retract, so ``known`` ids are reported without re-probing —
+        settle_joins passes its accumulated set, keeping each poll tick
+        at (64 - seen) non-blocking gets instead of a fixed 64."""
+        if self._client is None:
+            return []
+        gen = self.generation()
+        out = list(known)
+        for j in range(_JOIN_SLOT_CAP):
+            if j in out:
+                continue
+            try:
+                self._client.get(f"fleet/join/g{gen}/{j}", timeout_ms=0)
+                out.append(j)
+            except TimeoutError:
+                continue  # slot not announced — the expected answer
+            # any OTHER OSError propagates: a broken coord connection
+            # must not read as "no joiners announced" (settle_joins
+            # would agree on an EMPTY set and bump the generation while
+            # the announced joiners hang)
+        return sorted(out)
+
+    def _leader_adopt(self, key: str, ack_prefix: str, payload: bytes,
+                      leader: int, peers: Sequence[int],
+                      timeout_ms: int) -> bytes:
+        """The agreement tail ``settle_dead``/``settle_joins`` share:
+        the LEADER (lowest surviving rank) publishes its settled
+        payload under the generation-keyed ``key`` and collects one ack
+        per surviving peer — so it never tears its coord server down
+        under a peer still fetching — while every peer adopts the
+        published payload and acks the read."""
+        me = self.worker_index()
+        if me == leader:
+            self.put(key, payload)
+            dl = _retry.Deadline(timeout_ms / 1000.0)
+            for r in peers:
+                self.get(f"{ack_prefix}/{r}",
+                         timeout_ms=max(1, dl.remaining_ms()))
+            return payload
+        agreed = self.get(key, timeout_ms=timeout_ms)
+        self.put(f"{ack_prefix}/{me}", b"1")
+        return agreed
+
+    def settle_joins(self, max_age_ms: int = 5_000, poll_ms: int = 100,
+                     timeout_ms: Optional[int] = None,
+                     min_count: int = 0,
+                     dead: Sequence = ()) -> List[int]:
+        """One AGREED joiner set for every surviving incumbent — the
+        grow twin of ``settle_dead``. Join announcements are not atomic
+        either: a scale-out event's hosts come up at different
+        instants, so each incumbent keeps polling (and heartbeating)
+        until the announced set has been stable for one full window AND
+        holds at least ``min_count`` ids; then the lowest SURVIVING
+        rank publishes its settled set over the KV (generation-keyed)
+        and every other survivor adopts the published set, acking the
+        read. ``dead`` (a ``settle_dead`` result) makes the composed
+        shrink+grow resize work: the leader and the ack set are derived
+        from the survivors, never from ranks that can no longer ack.
+        Raises TimeoutError when ``min_count`` announcements never
+        materialize inside ``timeout_ms``."""
+        if self._client is None:
+            return []
+        if timeout_ms is None:
+            from paddle_tpu import flags as _flags
+
+            timeout_ms = _flags.get_flag("rpc_deadline_ms")
+        gen = self.generation()
+        dead_ranks = {int(str(d).rsplit("-", 1)[-1]) for d in dead}
+        survivors = [r for r in range(self.worker_num())
+                     if r not in dead_ranks]
+        deadline = _time.monotonic() + timeout_ms / 1000.0
+        cur: List[int] = []
+        stable = 0.0
+        with _monitor.stall_guard("fleet.settle_joins"):
+            while stable < max_age_ms or len(cur) < min_count:
+                self.heartbeat()
+                _time.sleep(poll_ms / 1000.0)
+                nxt = self.pending_joins(known=cur)
+                if nxt == cur and len(cur) >= min_count:
+                    stable += poll_ms
+                else:
+                    stable, cur = 0.0, nxt
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"settle_joins: {len(cur)} of {min_count} "
+                        f"expected joiners announced within {timeout_ms} "
+                        f"ms ({cur})")
+            agreed = self._leader_adopt(
+                f"fleet/resize/joins/g{gen}",
+                f"fleet/resize/jsack/g{gen}",
+                ",".join(str(j) for j in cur).encode(),
+                survivors[0], survivors[1:], timeout_ms)
+            return sorted(int(x) for x in agreed.decode().split(",")
+                          if x)
+
+    def plan_resize(self, dead_ids: Sequence, joins: Sequence = (),
+                    rank: Optional[int] = None,
+                    world: Optional[int] = None,
+                    join_id: Optional[int] = None) -> dict:
+        """Deterministic resized-world spec. Shrink: ``dead_ids``
+        (``worker-<r>`` ids or plain ranks; pass them through
+        ``settle_dead`` first so every survivor plans from the SAME
+        set). Grow: ``joins`` (settled join ids from ``settle_joins``)
+        — survivors keep their relative rank order and joiners take the
+        ranks after them, in join-id order, so every participant
+        derives the identical world from the same (dead, joins)
+        agreement. A joiner passes ``join_id`` instead of ``rank`` to
+        derive ITS new rank. Both compose: dead workers leave and fresh
+        capacity arrives in one resize. Chaos plans can tear this step
+        via the ``fleet.resize`` site (a raise here models a
+        participant that fails during the resize decision).
 
         Returns ``{"survivors": [old ranks], "rank": my new rank,
-        "world": new size, "dead": [dead old ranks]}``.
+        "world": new size, "dead": [dead old ranks]}`` plus
+        ``"joiners": [[join id, new rank], ...]`` when growing.
         """
         _F_RESIZE.hit()
         world = self.worker_num() if world is None else int(world)
-        rank = self.worker_index() if rank is None else int(rank)
         dead = set()
         for d in dead_ids:
             if isinstance(d, int):
@@ -421,14 +560,133 @@ class Fleet:
                 # client-less fallback stringifies whatever it was fed)
                 dead.add(int(str(d).rsplit("-", 1)[-1]))
         survivors = [r for r in range(world) if r not in dead]
-        if rank not in survivors:
-            raise ValueError(
-                f"rank {rank} is itself in the dead set {sorted(dead)}; "
-                f"a declared-dead worker must not plan the resize")
         if not survivors:
             raise ValueError(f"resize with no survivors (dead: {sorted(dead)})")
-        return {"survivors": survivors, "rank": survivors.index(rank),
-                "world": len(survivors), "dead": sorted(dead)}
+        join_list = sorted(int(j) for j in joins)
+        joiner_ranks = {j: len(survivors) + i
+                        for i, j in enumerate(join_list)}
+        if join_id is not None:
+            if int(join_id) not in joiner_ranks:
+                raise ValueError(
+                    f"join_id {join_id} is not in the settled join set "
+                    f"{join_list}; a joiner must announce and be settled "
+                    f"before planning")
+            new_rank = joiner_ranks[int(join_id)]
+        else:
+            rank = self.worker_index() if rank is None else int(rank)
+            if rank not in survivors:
+                raise ValueError(
+                    f"rank {rank} is itself in the dead set "
+                    f"{sorted(dead)}; a declared-dead worker must not "
+                    f"plan the resize")
+            new_rank = survivors.index(rank)
+        spec = {"survivors": survivors, "rank": new_rank,
+                "world": len(survivors) + len(join_list),
+                "dead": sorted(dead)}
+        if join_list:
+            spec["joiners"] = [[j, joiner_ranks[j]] for j in join_list]
+        return spec
+
+    def publish_join_plan(self, spec: dict, coord_endpoint: str,
+                          jax_endpoint: Optional[str] = None,
+                          timeout_ms: Optional[int] = None):
+        """Leader-only (rank 0): publish the grown-world plan — the
+        joiners' half of the agreement, carrying their assigned ranks
+        and the generation-N+1 recovery endpoints — then WAIT for every
+        joiner's ack before returning. The leader owns the
+        generation-N coord server and ``reexec_resized`` tears it down;
+        returning before the acks would strand a joiner mid-read."""
+        if timeout_ms is None:
+            from paddle_tpu import flags as _flags
+
+            timeout_ms = _flags.get_flag("rpc_deadline_ms")
+        gen = self.generation()
+        plan = {"survivors": spec["survivors"],
+                "dead": spec.get("dead", []),
+                "joiners": spec.get("joiners", []),
+                "world": spec["world"], "gen": gen + 1,
+                "coord": coord_endpoint, "jax": jax_endpoint}
+        self.put(f"fleet/resize/plan/g{gen}",
+                 _json.dumps(plan).encode())
+        dl = _retry.Deadline(timeout_ms / 1000.0)
+        for j, _r in spec.get("joiners", []):
+            self.get(f"fleet/resize/jack/g{gen}/{j}",
+                     timeout_ms=max(1, dl.remaining_ms()))
+
+    def join_world(self, coord_endpoint: str, join_id: int,
+                   connect_timeout_ms: Optional[int] = None,
+                   timeout_ms: Optional[int] = None,
+                   _client=None) -> dict:
+        """NEWCOMER side of scale-OUT: connect to the RUNNING world's
+        coord service, announce under the generation-keyed join slot,
+        wait for the leader's published plan, ack it, and return the
+        resize spec (rank/world/endpoints/generation) ready for
+        ``reexec_resized``. The two ``fleet.join`` fault-site hits —
+        before the announce and at plan adoption — let chaos plans tear
+        an admission at either seam. Metered into
+        ``pt_fleet_join_seconds`` (announce -> plan adopted)."""
+        from paddle_tpu import flags as _flags
+
+        if connect_timeout_ms is None:
+            connect_timeout_ms = _flags.get_flag("rpc_deadline_ms")
+        if timeout_ms is None:
+            timeout_ms = _flags.get_flag("rpc_deadline_ms")
+        if not 0 <= int(join_id) < _JOIN_SLOT_CAP:
+            # an out-of-range slot would announce where pending_joins
+            # never probes: a silent deterministic hang, not a join
+            raise ValueError(
+                f"join_id must be in [0, {_JOIN_SLOT_CAP}), got "
+                f"{join_id}")
+        t0 = _time.perf_counter()
+        client = _client
+        if client is None:
+            host, port = coord_endpoint.rsplit(":", 1)
+            client = _connect_retry(host, int(port), connect_timeout_ms)
+        try:
+            try:
+                # bounded BLOCKING read: a newcomer can connect in the
+                # window before rank 0's post-rendezvous publish, and a
+                # wrong-generation announce lands in a slot nobody
+                # probes. Worlds predating the key (which cannot settle
+                # joins anyway) fall back to generation 0 at timeout.
+                gen = int(_kv_get_retry(
+                    client, "fleet/generation",
+                    min(int(timeout_ms), 5_000)).decode())
+            except (TimeoutError, OSError, ValueError):
+                gen = 0
+            _F_JOIN.hit()  # hit 1: the announce
+            client.put(f"fleet/join/g{gen}/{int(join_id)}", b"1")
+            with _monitor.stall_guard("fleet.join"):
+                raw = _kv_get_retry(client, f"fleet/resize/plan/g{gen}",
+                                    timeout_ms)
+            plan = _json.loads(raw.decode())
+            _F_JOIN.hit()  # hit 2: plan adoption
+            joiner_ranks = {int(j): int(r)
+                            for j, r in plan.get("joiners", [])}
+            if int(join_id) not in joiner_ranks:
+                raise ValueError(
+                    f"join {join_id}: the leader's plan admitted only "
+                    f"{sorted(joiner_ranks)}; this announcement landed "
+                    f"after the join set settled — re-announce against "
+                    f"the next generation")
+            client.put(f"fleet/resize/jack/g{gen}/{int(join_id)}", b"1")
+        finally:
+            if _client is None:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+        dt = _time.perf_counter() - t0
+        _M_JOIN_SECONDS.observe(dt)
+        return {"survivors": plan["survivors"],
+                "dead": plan.get("dead", []),
+                "joiners": plan.get("joiners", []),
+                "rank": joiner_ranks[int(join_id)],
+                "world": int(plan["world"]),
+                "gen": int(plan.get("gen", 1)),
+                "coord_endpoint": plan.get("coord"),
+                "jax_endpoint": plan.get("jax"),
+                "join_latency_s": dt}
 
     def reexec_resized(self, spec: dict, coord_endpoint: str,
                        jax_endpoint: Optional[str] = None,
@@ -450,19 +708,32 @@ class Fleet:
         same flags (hyperparameters must not silently reset to defaults
         across generations). A ``python -m pkg.mod`` entrypoint re-runs
         as a plain script path — pass ``script``/``argv`` explicitly if
-        your ``__main__`` relies on package-relative imports."""
+        your ``__main__`` relies on package-relative imports.
+
+        Grown worlds: a JOINER re-execs through the same call with the
+        spec ``join_world`` returned. Its env must be complete and
+        self-consistent for ``EnvRoleMaker`` — rank/world from the
+        spec, the generation from the PLAN (``spec["gen"]``, not this
+        process's own generation + 1: a joiner's own is 0), and a stale
+        inherited ``PT_JAX_COORD_ENDPOINT`` scrubbed when the caller
+        passes none (it names the DEAD generation's PJRT coordinator;
+        EnvRoleMaker's coord-host default is the correct one)."""
         env = dict(_os.environ)
         env.update({
             "PT_TRAINER_ID": str(spec["rank"]),
             "PT_TRAINERS": str(spec["world"]),
             "PT_COORD_ENDPOINT": coord_endpoint,
-            "PT_GEN": str(self.generation() + 1),
+            "PT_GEN": str(int(spec.get("gen", self.generation() + 1))),
         })
         if jax_endpoint:
             env["PT_JAX_COORD_ENDPOINT"] = jax_endpoint
+        else:
+            env.pop("PT_JAX_COORD_ENDPOINT", None)
         if extra_env:
             env.update({k: str(v) for k, v in extra_env.items()})
-        _M_RESIZES.inc()
+        # direction derives from the SPEC, so survivors and joiners
+        # meter identically (resize_direction is the one definition)
+        _M_RESIZES.inc(labels={"direction": resize_direction(spec)})
         self.stop_worker()
         script = script or _os.path.abspath(_sys.argv[0])
         args = list(_sys.argv[1:] if argv is None else argv)

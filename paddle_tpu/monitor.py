@@ -106,6 +106,7 @@ import io
 import json
 import os
 import queue
+import sys
 import threading
 import time
 import warnings
@@ -1168,12 +1169,20 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
                         body = to_prometheus().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif path == "/healthz":
+                    # serving-engine lifecycle rows (serving/draining/
+                    # closed) WITHOUT importing the serving plane into
+                    # processes that never used it: a replica being
+                    # rotated out must be visible to its health probe
+                    # before its queue is torn down
+                    srv = sys.modules.get("paddle_tpu.serving")
                     body = json.dumps({
                         "status": "ok",
                         "telemetry": _enabled,
                         "uptime_s": time.time() - _server_started_ts,
                         "steps_buffered": len(_STEP_RING),
                         "stalls": len(_STALLS),
+                        "engines": (srv.engine_states()
+                                    if srv is not None else {}),
                     }).encode()
                     ctype = "application/json"
                 elif path == "/steps":

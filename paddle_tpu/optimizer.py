@@ -78,6 +78,30 @@ class Optimizer:
     def _get_accumulator(self, name, param):
         return self._accumulators[name][param.name]
 
+    def slot_descriptor(self) -> Dict[str, Dict[str, str]]:
+        """{slot var name -> {"param": owning param, "slot": kind}} for
+        every accumulator this optimizer created (moments, velocities,
+        beta pows, ...), plus the auto-created learning-rate var.
+
+        This is the identity that survives a rebuild: slot var NAMES
+        come from ``unique_name.generate`` and drift whenever a program
+        is rebuilt differently (per-stage pipeline programs, a
+        differently-ordered build, a warm process's shifted counters),
+        but (param, kind) does not. The checkpoint manifest records the
+        descriptor per entry (``save_checkpoint(slots=)``), and
+        ``checkpoint.reshard_optimizer_state`` re-keys saved slot state
+        onto the RESTORING program's names through it."""
+        out: Dict[str, Dict[str, str]] = {}
+        for kind, d in self._accumulators.items():
+            for pname, var in d.items():
+                out[var.name] = {"param": pname, "slot": kind}
+        if self._lr_var is not None and \
+                not isinstance(self._lr_input, Variable):
+            # only the var WE created (a user LR-schedule Variable
+            # belongs to the program, not the optimizer state)
+            out[self._lr_var.name] = {"param": "", "slot": "learning_rate"}
+        return out
+
     # --- hooks for subclasses ---
 
     def _create_accumulators(self, block, parameters):

@@ -100,6 +100,11 @@ _M_PARTIAL = _monitor.counter(
     "pt_ckpt_partial_restores_total",
     "arrays reassembled from a partial shard-file subset whose surviving "
     "shards still covered every element")
+_M_SLOT_REKEYS = _monitor.counter(
+    "pt_ckpt_slot_rekeys_total",
+    "optimizer slot-state entries re-keyed onto a differently-built "
+    "restoring program's slot names via the manifest's (param, kind) "
+    "descriptors (reshard_optimizer_state)")
 
 _F_WRITE = _faults.site("ckpt.write_shards")
 _F_COMMIT = _faults.site("ckpt.commit")
@@ -326,6 +331,7 @@ def save_checkpoint(
     async_save: bool = False,
     coordinator="auto",
     process_index: Optional[int] = None,
+    slots: Optional[Dict[str, dict]] = None,
 ):
     """Write ``state`` (name -> array) to ``dirname/checkpoint_<step>``
     via the staging-dir commit protocol (module docstring).
@@ -342,12 +348,30 @@ def save_checkpoint(
     ``.wait()`` before relying on the files), else None — with
     ``async_save`` only the device->host snapshot happens here; checksum,
     serialization and the commit run on a background thread.
+
+    ``slots`` ({var name -> {"param": ..., "slot": ...}}, e.g. an
+    ``Optimizer.slot_descriptor()``) records the optimizer slot-state
+    descriptor on each covered manifest entry, so a restore into a
+    DIFFERENTLY-BUILT program (per-stage pipeline layouts, drifted
+    unique-name counters) can re-key the state through
+    ``reshard_optimizer_state`` instead of silently dropping it.
     """
     _reap_async()
     ckpt_dir = os.path.join(dirname, f"checkpoint_{step}")
     stage_dir = ckpt_dir + _STAGING_SUFFIX
-    pid = jax.process_index() if process_index is None else int(process_index)
     coord = _resolve_coordinator(coordinator)
+    if process_index is not None:
+        pid = int(process_index)
+    elif coord is not None:
+        # the writer identity that names shard files / manifest
+        # fragments: the FLEET rank when a commit coordinator is
+        # engaged. Identical to jax.process_index() in a jax.distributed
+        # fleet, but in a coordination-only fleet (PT_COORD_ONLY) every
+        # rank's jax process index is 0 — four writers would clobber one
+        # shards_0.npz mid-commit
+        pid = coord.rank
+    else:
+        pid = jax.process_index()
     rank = coord.rank if coord is not None else pid
     seq = _next_coord_seq() if coord is not None else 0
 
@@ -375,6 +399,8 @@ def save_checkpoint(
                 _copy_async(sh.data)
                 snap.append((fkey, sh.data))
                 entry["shards"][fkey] = slices[i]["index"]
+            if slots and name in slots:
+                entry["slot"] = dict(slots[name])
             manifest[name] = entry
         elif rank == 0:
             if isinstance(v, jax.Array):
@@ -386,6 +412,8 @@ def save_checkpoint(
                 "sharding": _mesh.sharding_descriptor(
                     getattr(v, "sharding", None)),
             }
+            if slots and name in slots:
+                manifest[name]["slot"] = dict(slots[name])
 
     # Pass 2: materialize the host snapshot IN THE CALLER'S THREAD — the
     # next training step may donate these buffers, so device arrays must
@@ -738,6 +766,83 @@ def reshard(values: Dict[str, object], shardings: dict) -> Dict[str, object]:
     return out
 
 
+def manifest_slots(dirname: str, step: int) -> Dict[str, dict]:
+    """{var name -> {"param": ..., "slot": ...}} recorded by
+    ``save_checkpoint(slots=)`` for ``checkpoint_<step>``, merged across
+    every process's manifest fragment. Manifest-only read (no array
+    data) — the resume path calls this right after ``load_latest`` to
+    decide whether slot re-keying applies. Empty for checkpoints saved
+    without descriptors (pre-ISSUE-14 or slot-less saves)."""
+    ckpt_dir = os.path.join(dirname, f"checkpoint_{step}")
+    out: Dict[str, dict] = {}
+    for fn in sorted(os.listdir(ckpt_dir)):
+        if fn.startswith(_MANIFEST):
+            path = os.path.join(ckpt_dir, fn)
+            _F_READ.hit(path=path)
+            with open(path) as f:
+                frag = json.load(f)
+            for name, entry in frag.items():
+                if "slot" in entry:
+                    out.setdefault(name, entry["slot"])
+    return out
+
+
+def reshard_optimizer_state(
+    values: Dict[str, object],
+    saved_slots: Dict[str, dict],
+    target_slots: Dict[str, dict],
+    shardings: Optional[dict] = None,
+    strategy=None,
+) -> Dict[str, object]:
+    """Re-KEY saved optimizer slot state onto the restoring program's
+    slot variables, and optionally re-PLACE it onto that program's
+    shardings — the slot-state half of mesh portability (ISSUE 14).
+
+    Parameters restore by NAME (users pin them via ParamAttr), but slot
+    var names come from unique-name counters and drift whenever the
+    restoring program is built differently — per-stage pipeline
+    programs whose stage op sets differ across world sizes, a rebuild
+    in a warm process, a reordered build. Restoring those by name
+    silently re-initializes the moments to zero. This function joins
+    ``saved_slots`` (the manifest's descriptors, ``manifest_slots``)
+    against ``target_slots`` (the restoring optimizer's
+    ``slot_descriptor()``) on the stable (param, kind) identity:
+
+    - a matched slot moves to the restoring name (metered into
+      ``pt_ckpt_slot_rekeys_total`` when the name actually changed) and
+      is placed through ``shardings``/``strategy`` exactly like
+      ``reshard``/``restore_scope`` place parameters;
+    - a saved slot with no target is DROPPED (its parameter is not part
+      of the restoring program — the per-stage case);
+    - non-slot entries pass through untouched.
+
+    Returns a new dict; ``values`` is not mutated."""
+    saved_slots = saved_slots or {}
+    target_slots = target_slots or {}
+    by_key = {}
+    for name, d in saved_slots.items():
+        by_key[(d.get("param"), d.get("slot"))] = name
+    out = {n: v for n, v in values.items() if n not in saved_slots}
+    sh = dict(shardings or {})
+    rekeyed = 0
+    for tname, d in target_slots.items():
+        sname = by_key.get((d.get("param"), d.get("slot")))
+        if sname is None or sname not in values:
+            continue  # nothing saved for this slot: leave initialized
+        v = values[sname]
+        if tname not in sh and strategy is not None:
+            sh[tname] = strategy.sharding_for(tname)
+        target = sh.get(tname)
+        if target is not None:
+            v = reshard({tname: v}, {tname: target})[tname]
+        out[tname] = v
+        if tname != sname:
+            rekeyed += 1
+    if rekeyed:
+        _M_SLOT_REKEYS.inc(rekeyed)
+    return out
+
+
 def _read_raw(ckpt_dir: str, load_payload: bool = True):
     """(merged manifest, {file key -> array}) straight off disk. With
     ``load_payload=False`` the payload maps every key present in the
@@ -832,14 +937,18 @@ def _load_one(dirname: str, step: int) -> Dict[str, np.ndarray]:
 
 
 def save_scope(dirname: str, scope=None, step: int = 0,
-               async_save: bool = False, names=None):
-    """Checkpoint a Scope's state (default: every var in the scope)."""
+               async_save: bool = False, names=None,
+               slots: Optional[Dict[str, dict]] = None):
+    """Checkpoint a Scope's state (default: every var in the scope).
+    ``slots`` records optimizer slot descriptors in the manifest (see
+    ``save_checkpoint``)."""
     from paddle_tpu.executor import global_scope
 
     scope = scope or global_scope()
     names = list(names) if names is not None else scope.var_names()
     state = {n: scope.find_var(n) for n in names}
-    return save_checkpoint(dirname, state, step=step, async_save=async_save)
+    return save_checkpoint(dirname, state, step=step,
+                           async_save=async_save, slots=slots)
 
 
 def restore_scope(dirname: str, scope=None, step: Optional[int] = None,

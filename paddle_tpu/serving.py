@@ -82,6 +82,46 @@ _M_TTFT_SECONDS = _monitor.histogram(
     "time to first token (request submit -> first token on host)",
     buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
              30.0))
+_M_ENGINE_STATE = _monitor.gauge(
+    "pt_serve_engine_state",
+    "per-engine lifecycle state by engine id: 0=serving, 1=draining, "
+    "2=closed — a replica being rotated out is observable BEFORE its "
+    "queue is torn down")
+
+ENGINE_STATES = ("serving", "draining", "closed")
+# engine id -> lifecycle state, bounded (closed engines age out so the
+# /healthz payload and the gauge's label set stay small). Mutated by
+# engine threads and iterated by the monitor server's handler threads:
+# every access holds _ENGINE_STATE_LOCK.
+_ENGINE_STATE_CAP = 32
+_ENGINE_STATE_LOCK = threading.Lock()
+_ENGINE_STATES: "collections.OrderedDict[int, str]" = \
+    collections.OrderedDict()
+
+
+def _note_engine_state(engine_id: int, state: str):
+    with _ENGINE_STATE_LOCK:
+        _ENGINE_STATES[engine_id] = state
+        _ENGINE_STATES.move_to_end(engine_id)
+        while len(_ENGINE_STATES) > _ENGINE_STATE_CAP:
+            _ENGINE_STATES.popitem(last=False)
+        snapshot = list(_ENGINE_STATES.items())
+    # the gauge mirrors the bounded map wholesale (Gauge.replace, its
+    # own atomic swap): engines aged out of the map drop their cells
+    # too, so a process churning many short-lived engines never
+    # accretes stale labels
+    _M_ENGINE_STATE.replace(
+        [({"engine": str(k)}, float(ENGINE_STATES.index(v)))
+         for k, v in snapshot])
+
+
+def engine_states() -> Dict[str, str]:
+    """{engine id -> "serving" | "draining" | "closed"} for the
+    /healthz monitor route: a serving replica's lifecycle is liveness
+    information — a load balancer must stop routing to a draining
+    engine before its queue disappears."""
+    with _ENGINE_STATE_LOCK:
+        return {str(k): v for k, v in _ENGINE_STATES.items()}
 
 # chaos hooks (faults.py): a raise at serve.enqueue drills queue-path
 # failures, a delay/raise at serve.decode drills a stalled/failed decode
@@ -192,8 +232,13 @@ class ServingEngine:
     (with queue-depth backpressure and optional per-request deadlines);
     the caller drives ``step()`` — or ``run_until_idle()`` — to make
     progress; ``drain()`` stops admissions and finishes the in-flight
-    set; ``close()`` drains and releases the compiled entries.
+    set; ``close()`` drains and releases the compiled entries. The
+    lifecycle (serving -> draining -> closed) is observable: ``state``
+    here, ``pt_serve_engine_state`` on /metrics, and per-engine rows on
+    the /healthz route (``engine_states``).
     """
+
+    _eid = itertools.count(1)
 
     def __init__(self, cfg, weights, *, slots: int = 4, src_len: int = 32,
                  max_len: int = 32, bos_id: int = 0, end_id: int = 1,
@@ -236,7 +281,9 @@ class ServingEngine:
         self.decode_steps = 0
         self.tokens_emitted = 0
         self.completed = 0
+        self.engine_id = next(ServingEngine._eid)
         _ENGINES.add(self)
+        _note_engine_state(self.engine_id, "serving")
 
     # --- request intake ---
 
@@ -339,12 +386,20 @@ class ServingEngine:
         Queued-but-unadmitted requests finish with outcome 'drained'.
         Returns True when everything settled inside ``timeout_s``."""
         with self._lock:
+            if self._closed:
+                # nothing left to drain — and the published lifecycle
+                # must not regress closed -> draining for an idempotent
+                # caller (checked under the SAME lock close() flips
+                # _closed with, so a drain racing a close cannot pass
+                # the check and then publish 'draining' afterwards)
+                return True
             # flag + queue sweep under one lock: a racing submit either
             # landed (and is drained here) or raises EngineClosed
             self._draining = True
             while self._queue:
                 self._queue.popleft()._finish("drained")
             _publish_gauges()
+            _note_engine_state(self.engine_id, "draining")
         t0 = time.perf_counter()
         while self.busy():
             self.step()
@@ -362,7 +417,11 @@ class ServingEngine:
         if self._closed:
             return
         self.drain(drain_timeout_s)
-        self._closed = True
+        with self._lock:
+            # under the same lock drain() checks: once this flips, a
+            # concurrent drain can no longer publish 'draining' over
+            # the terminal 'closed' state below
+            self._closed = True
         self._pending = None
         for s in self._slots:
             req, s.request = s.request, None
@@ -371,6 +430,7 @@ class ServingEngine:
         self._exe.release_scope(self.scope)
         self.scope.clear()
         _ENGINES.discard(self)
+        _note_engine_state(self.engine_id, "closed")
         _publish_gauges()
 
     # --- internals ---
@@ -487,11 +547,18 @@ class ServingEngine:
         self.completed += 1
         self._slots[i].request = None
 
+    @property
+    def state(self) -> str:
+        return ("closed" if self._closed
+                else "draining" if self._draining else "serving")
+
     def stats(self) -> Dict:
         """One JSON-able row for the /serve route."""
         with self._lock:
             queued = len(self._queue)
         return {
+            "engine_id": self.engine_id,
+            "state": self.state,
             "slots": self.slots,
             "slots_active": int(self._active_mask().sum()),
             "queue_depth": queued,

@@ -1,37 +1,58 @@
-"""Elastic-resize fleet worker for the 8->4 shrink chaos test (ISSUE 7;
-SURVEY.md §5 failure detection/recovery + ROADMAP item 3 elastic resize).
+"""Elastic-resize fleet worker for the shrink AND grow chaos drills
+(ISSUE 7 8->4 shrink; ISSUE 14 4->8 scale-OUT; SURVEY.md §5 failure
+detection/recovery + ROADMAP item 3 elastic resize).
 
-Generation 0: 8 workers train; EVERY worker participates in the
-per-step coordinated checkpoint save (the multi-host commit barrier:
-non-zero ranks write their manifest fragment + shard file, ack over the
-fleet KV, rank 0 publishes only after all acks). The victims die at the
-start of a chosen step, driven by a SEEDED fault plan
-(`elastic.step:raise@N` via PT_FLAGS_fault_plan, so the chaos run
-replays exactly); only their heartbeats going stale reveals the deaths.
-Survivors' ``fleet.barrier_or_dead`` returns the dead ids; each derives
-the SAME shrunk world via ``fleet.plan_resize`` and re-execs itself
-through ``fleet.reexec_resized`` (generation 1, pre-provisioned
-recovery endpoints).
+SHRINK (ISSUE 7): generation 0: 8 workers train; EVERY worker
+participates in the per-step coordinated checkpoint save (the
+multi-host commit barrier: non-zero ranks write their manifest fragment
++ shard file, ack over the fleet KV, rank 0 publishes only after all
+acks). The victims die at the start of a chosen step, driven by a
+SEEDED fault plan (`elastic.step:raise@N` via PT_FLAGS_fault_plan, so
+the chaos run replays exactly); only their heartbeats going stale
+reveals the deaths. Survivors' ``fleet.barrier_or_dead`` returns the
+dead ids; each derives the SAME shrunk world via ``fleet.plan_resize``
+and re-execs itself through ``fleet.reexec_resized`` (generation 1,
+pre-provisioned recovery endpoints).
 
-Generation 1: 4 workers rendezvous fresh, restore the newest VALID
-checkpoint via ``checkpoint.load_latest`` — committed by an 8-writer
-world (8 manifest fragments + 8 shard files), reassembled by a 4-worker
-one — and finish the remaining steps, so the harness can assert loss
-parity against an uninterrupted single-process run.
+GROW (ISSUE 14): generation 0: 4 workers train. Newcomer processes
+(PT_JOIN_ID set) announce themselves against the RUNNING world through
+``fleet.join_world`` — the generation-keyed join protocol over fleet
+KV — and wait for the leader's published plan. At PT_GROW_AT_STEP the
+incumbents settle the announced joiner set (``fleet.settle_joins``,
+same stability-window agreement settle_dead uses), derive the grown
+world (``plan_resize(joins=...)``, survivors keep relative order,
+joiners take the ranks after them), rank 0 publishes the plan +
+recovery endpoints for the joiners, and EVERYONE re-execs to
+generation 1. The 8-worker generation restores the newest valid
+4-writer checkpoint — optimizer slot state re-keyed through
+``checkpoint.reshard_optimizer_state`` — and, with
+PT_FLAGS_compile_cache_dir set, warm-starts every executable from the
+persistent compile cache (zero fresh compiles on rejoin: the
+generation-0 incumbents populated the disk tier, and the owning-shard
+topology key is world-size independent for local executables).
+
+Generation 1 (both drills): workers rendezvous fresh, restore the
+newest VALID checkpoint via ``checkpoint.load_latest`` and finish the
+remaining steps, so the harness can assert loss parity against an
+uninterrupted single-process run.
 
 Compute is REPLICATED (every worker runs the full global batch on its
 local device): this environment's jax/CPU build cannot execute
 multiprocess XLA computations (the same pre-existing wall behind the
-test_fleet/test_fleet_recovery parity failures), and the drill's
+test_fleet/test_fleet_recovery parity failures), and the drills'
 subject is the host-side recovery plane — seeded kill, stale-heartbeat
-detection, resize agreement, re-exec, commit barrier, cross-world
-restore. Bit-exact SHARDED save-on-A/restore-on-B is proven in-process
-by the mesh matrix in tests/test_checkpoint.py.
+detection, join announcement/settling, resize agreement, re-exec,
+commit barrier, cross-world restore, compile-cache warm start.
+Bit-exact SHARDED save-on-A/restore-on-B — parameters AND optimizer
+slot state — is proven in-process by the mesh matrices in
+tests/test_checkpoint.py.
 
 Run (harness: tests/test_elastic_resize.py):
   PT_TRAINER_ID=r PT_TRAINERS=8 PT_COORD_ENDPOINT=127.0.0.1:p
   PT_RECOVER_PORT=p2 PT_RECOVER_JAX_PORT=p3 PT_CKPT_DIR=dir
-  PT_FLAGS_fault_plan='elastic.step:raise@3'  # victims only
+  PT_FLAGS_fault_plan='elastic.step:raise@3'  # shrink victims only
+  PT_GROW_AT_STEP=2 PT_EXPECT_JOINERS=4       # grow incumbents only
+  PT_JOIN_ID=j PT_JOIN_TARGET=127.0.0.1:p     # grow joiners only
   python fleet_resize_worker.py
 """
 
@@ -53,7 +74,7 @@ if __name__ == "__main__":
 import numpy as np  # noqa: E402
 
 import paddle_tpu as fluid  # noqa: E402
-from paddle_tpu import faults, layers  # noqa: E402
+from paddle_tpu import compile_cache, faults, layers  # noqa: E402
 from paddle_tpu.executor import global_scope  # noqa: E402
 from paddle_tpu.incubate.fleet import fleet  # noqa: E402
 from paddle_tpu.parallel import checkpoint as ckpt  # noqa: E402
@@ -113,34 +134,58 @@ def build():
                 initializer=fluid.initializer.NumpyArrayInitializer(b2)),
         )
         loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
-        fluid.optimizer.SGD(0.1).minimize(loss)
-    return main, startup, loss
+        # Momentum, not SGD: velocity slot state makes the resumed-loss
+        # parity assert prove optimizer-state survival across the resize
+        opt = fluid.optimizer.Momentum(0.1, momentum=0.9)
+        opt.minimize(loss)
+    return main, startup, loss, opt
 
 
 def main():
     gen = fleet.generation()
     ckpt_dir = os.environ["PT_CKPT_DIR"]
 
+    join_id = os.environ.get("PT_JOIN_ID")
+    if join_id is not None and gen == 0:
+        # NEWCOMER: announce against the running generation-0 world and
+        # wait for the leader's plan; then re-exec as a full member of
+        # generation 1 (complete EnvRoleMaker env from the plan)
+        spec = fleet.join_world(os.environ["PT_JOIN_TARGET"],
+                                join_id=int(join_id), timeout_ms=120_000)
+        print("JOIN_RESULT " + json.dumps({
+            "join_id": int(join_id), "rank": spec["rank"],
+            "world": spec["world"],
+            "join_latency_s": spec["join_latency_s"]}), flush=True)
+        fleet.reexec_resized(spec,
+                             coord_endpoint=spec["coord_endpoint"],
+                             jax_endpoint=spec.get("jax_endpoint"))
+
     fleet.init()
     rank, n = fleet.worker_index(), fleet.worker_num()
 
-    main_prog, startup, loss = build()
+    main_prog, startup, loss, opt = build()
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
+    slots = opt.slot_descriptor()
 
     start_step = 0
     if gen == 1:
-        # cross-world restore: serials were committed by the LARGER
+        # cross-world restore: serials were committed by the OTHER-SIZED
         # world (one manifest fragment + shard file per old rank);
-        # load_latest reassembles them regardless of who saved
+        # load_latest reassembles them regardless of who saved, and
+        # optimizer slot state is re-keyed onto THIS build's slot names
+        # (identity here — the drift matrix is tests/test_checkpoint.py)
         loaded = ckpt.load_latest(ckpt_dir)
-        assert loaded is not None, "no valid checkpoint after shrink"
+        assert loaded is not None, "no valid checkpoint after resize"
         start_step = loaded[0]
+        values = ckpt.reshard_optimizer_state(
+            loaded[1], ckpt.manifest_slots(ckpt_dir, start_step), slots)
         scope = global_scope()
-        for k, v in loaded[1].items():
+        for k, v in values.items():
             scope.set(k, v)
 
     host = os.environ["PT_COORD_ENDPOINT"].rsplit(":", 1)[0]
+    grow_at = os.environ.get("PT_GROW_AT_STEP")
     losses = []
     batches = global_batches()
     for i in range(start_step, STEPS):
@@ -148,6 +193,28 @@ def main():
             _F_STEP.hit()  # victims' seeded plan kills them HERE
         except faults.InjectedFault:
             os._exit(1)  # abrupt death: heartbeat goes stale, no farewell
+        if gen == 0 and grow_at is not None and i == int(grow_at):
+            # INCUMBENT at the grow step: settle the announced joiner
+            # set, derive the grown world, leader publishes the plan
+            # (and holds the coord server up until every joiner acked),
+            # everyone re-execs to generation 1
+            joins = fleet.settle_joins(
+                max_age_ms=1500,
+                min_count=int(os.environ.get("PT_EXPECT_JOINERS", "1")))
+            spec = fleet.plan_resize((), joins=joins)
+            coord_ep = f"{host}:{os.environ['PT_RECOVER_PORT']}"
+            jax_ep = f"{host}:{os.environ['PT_RECOVER_JAX_PORT']}"
+            if fleet.is_first_worker():
+                fleet.publish_join_plan(spec, coord_endpoint=coord_ep,
+                                        jax_endpoint=jax_ep)
+            from paddle_tpu.incubate.fleet.fleet_base import (
+                resize_direction,
+            )
+            print("RESIZE_PLAN " + json.dumps({
+                "rank": rank, "direction": resize_direction(spec),
+                "world": spec["world"], "joins": joins}), flush=True)
+            fleet.reexec_resized(spec, coord_endpoint=coord_ep,
+                                 jax_endpoint=jax_ep)
         dead = fleet.barrier_or_dead(f"step{i}-g{gen}", max_age_ms=1500)
         if dead:
             # simultaneous deaths go stale at different poll instants:
@@ -168,14 +235,23 @@ def main():
         fleet.heartbeat()
         # EVERY rank joins the coordinated save (commit barrier): rank 0
         # publishes only after all acks, so a committed serial always
-        # holds every writer's fragments
-        ckpt.save_scope(ckpt_dir, step=i + 1)
+        # holds every writer's fragments. The manifest records the slot
+        # descriptors so a differently-built restore can re-key them.
+        ckpt.save_scope(ckpt_dir, step=i + 1, slots=slots)
 
-    print("FLEET_RESULT " + json.dumps({
+    result = {
         "rank": rank, "gen": gen, "world": n, "start_step": start_step,
         "dead_seen": os.environ.get("PT_DEAD_SEEN", "").split(",")
         if os.environ.get("PT_DEAD_SEEN") else [],
-        "losses": losses}), flush=True)
+        "losses": losses}
+    if compile_cache.active():
+        # the grow drill's warm-start accounting: generation 1 must
+        # resolve every executable from the disk tier (zero fresh
+        # compiles on rejoin)
+        st = compile_cache.stats()
+        result["ccache"] = {"hits": st["hits"], "misses": st["misses"],
+                            "errors": st["errors"]}
+    print("FLEET_RESULT " + json.dumps(result), flush=True)
     fleet.barrier(f"done-g{gen}")
     fleet.stop_worker()
 

@@ -1215,3 +1215,177 @@ def test_trainer_never_prunes_the_last_valid_checkpoint(tmp_path):
     faults.disarm()
     assert ckpt.latest_step(str(tmp_path)) == 1
     assert ckpt.validate_checkpoint(str(tmp_path), 1)
+
+
+# --------------------------------------------------------------------------
+# optimizer slot-state resharding (ISSUE 14): manifest slot descriptors,
+# re-keying onto a differently-built program's slot names, mesh matrix
+# --------------------------------------------------------------------------
+
+def _slot_state(mesh):
+    """Param + Adam-style slot state sharded on ``mesh`` (2x4 TP shape),
+    with the manifest slot descriptors an Optimizer would record."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    r = np.random.RandomState(3)
+    w = r.randn(8, 16).astype(np.float32)
+    m1 = r.randn(8, 16).astype(np.float32)
+    m2 = np.abs(r.randn(8, 16)).astype(np.float32)
+    b1p = np.asarray([0.81], np.float32)
+    state = {
+        "w": _sharded(w, mesh, P(None, "model")),
+        "w_moment1_0": _sharded(m1, mesh, P(None, "model")),
+        "w_moment2_0": _sharded(m2, mesh, P(None, "model")),
+        "w_beta1_pow_0": jax.device_put(
+            b1p, NamedSharding(mesh, P())),
+    }
+    slots = {
+        "w_moment1_0": {"param": "w", "slot": "moment1"},
+        "w_moment2_0": {"param": "w", "slot": "moment2"},
+        "w_beta1_pow_0": {"param": "w", "slot": "beta1_pow"},
+    }
+    return state, slots, {"w": w, "m1": m1, "m2": m2, "b1p": b1p}
+
+
+def test_manifest_records_slot_descriptors(tmp_path):
+    """save_checkpoint(slots=) lands a ``slot`` field on each covered
+    manifest entry; manifest_slots reads the merged descriptor map back
+    without touching any array data."""
+    mesh_a = _grid_mesh((2, 4), ("data", "model"))
+    state, slots, _ = _slot_state(mesh_a)
+    ckpt.save_checkpoint(str(tmp_path), state, step=1, slots=slots)
+    assert ckpt.manifest_slots(str(tmp_path), 1) == slots
+    with open(str(tmp_path / "checkpoint_1" / "manifest.json.0")) as f:
+        man = json.load(f)
+    assert man["w_moment1_0"]["slot"] == {"param": "w", "slot": "moment1"}
+    assert "slot" not in man["w"]  # parameters carry no slot field
+    # v2 validation is indifferent to the new optional field
+    assert ckpt.validate_checkpoint(str(tmp_path), 1)
+
+
+def test_optimizer_slot_state_mesh_matrix_bit_exact(tmp_path):
+    """THE ISSUE 14 slot matrix (mirrors the parameter mesh matrix):
+    slot state saved under a 2x4 TP layout restores bit-exact onto a
+    1x8 mesh and onto a 4-device layout, re-KEYED onto the restoring
+    program's (drifted) slot names and re-PLACED onto its shardings."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    mesh_a = _grid_mesh((2, 4), ("data", "model"))
+    state, slots, raw = _slot_state(mesh_a)
+    ckpt.save_checkpoint(str(tmp_path), state, step=1, slots=slots)
+    saved_slots = ckpt.manifest_slots(str(tmp_path), 1)
+
+    # the restoring build's unique_name counters drifted: _0 -> _3
+    target_slots = {
+        "w_moment1_3": {"param": "w", "slot": "moment1"},
+        "w_moment2_3": {"param": "w", "slot": "moment2"},
+        "w_beta1_pow_3": {"param": "w", "slot": "beta1_pow"},
+    }
+    targets = [
+        (_grid_mesh((8,), ("model",)), P("model", None)),
+        (_grid_mesh((4,), ("model",), ndev=4), P(None, "model")),
+    ]
+    for mesh_b, spec in targets:
+        vals = ckpt.load_checkpoint(str(tmp_path), step=1)
+        shardings = {n: NamedSharding(mesh_b, spec if "pow" not in n
+                                      else P())
+                     for n in target_slots}
+        out = ckpt.reshard_optimizer_state(
+            vals, saved_slots, target_slots, shardings=shardings)
+        # re-keyed: the saved names are gone, the restoring names carry
+        # the values bit-exact, placed on the restoring mesh
+        for old in saved_slots:
+            assert old not in out
+        for new, want in (("w_moment1_3", raw["m1"]),
+                          ("w_moment2_3", raw["m2"]),
+                          ("w_beta1_pow_3", raw["b1p"])):
+            assert isinstance(out[new], jax.Array)
+            assert out[new].sharding.mesh.shape == mesh_b.shape
+            np.testing.assert_array_equal(np.asarray(out[new]), want,
+                                          err_msg=new)
+        # the parameter itself passes through untouched
+        np.testing.assert_array_equal(np.asarray(out["w"]), raw["w"])
+
+
+def test_reshard_optimizer_state_strategy_placement_and_drops(tmp_path):
+    """strategy= resolves each target slot's sharding through
+    sharding_for (the restore_scope convention); slots whose (param,
+    kind) has no target in the restoring program are DROPPED — the
+    per-stage pipeline case, where a stage restores only its own
+    params' state — and the re-key events are metered."""
+    monitor.enable()
+    mesh_a = _grid_mesh((2, 4), ("data", "model"))
+    state, slots, raw = _slot_state(mesh_a)
+    state["other_moment1_0"] = np.ones(3, np.float32)
+    slots["other_moment1_0"] = {"param": "other", "slot": "moment1"}
+    ckpt.save_checkpoint(str(tmp_path), state, step=1, slots=slots)
+
+    strategy_b = DistributedStrategy(
+        _grid_mesh((8,), ("model",)), data_axis=None,
+        rules=[ShardingRule(r"^w(_|$)", P(None, "model"))])
+    target_slots = {
+        "w_moment1_7": {"param": "w", "slot": "moment1"},
+        "w_moment2_7": {"param": "w", "slot": "moment2"},
+        "w_beta1_pow_7": {"param": "w", "slot": "beta1_pow"},
+        # no saved (param, kind) match: stays absent, never invented
+        "w_extra_7": {"param": "w", "slot": "extra"},
+    }
+    rk0 = monitor.counter("pt_ckpt_slot_rekeys_total").value()
+    vals = ckpt.load_checkpoint(str(tmp_path), step=1)
+    out = ckpt.reshard_optimizer_state(
+        vals, ckpt.manifest_slots(str(tmp_path), 1), target_slots,
+        strategy=strategy_b)
+    # 'other' has no target in this program: its slot state is dropped
+    assert "other_moment1_0" not in out and "w_extra_7" not in out
+    assert monitor.counter("pt_ckpt_slot_rekeys_total").value() == rk0 + 3
+    np.testing.assert_array_equal(np.asarray(out["w_moment1_7"]),
+                                  raw["m1"])
+    # strategy placement: scalar state replicated, matrix state sharded
+    assert len(out["w_moment1_7"].sharding.device_set) == 8
+    sd = pmesh.sharding_descriptor(out["w_beta1_pow_7"].sharding)
+    assert sd["spec"] == []  # P(): replicated scalar state
+    # identity re-key (same names) is a no-op passthrough, not a count
+    out2 = ckpt.reshard_optimizer_state(
+        dict(vals), ckpt.manifest_slots(str(tmp_path), 1),
+        {n: dict(d) for n, d in slots.items()})
+    assert monitor.counter("pt_ckpt_slot_rekeys_total").value() == rk0 + 3
+    np.testing.assert_array_equal(np.asarray(out2["w_moment1_0"]),
+                                  raw["m1"])
+
+
+def test_trainer_resume_rekeys_drifted_slot_names(tmp_path):
+    """A resized/rebuilt trainer resume must not silently zero the
+    moments: the SAME build code in an already-warm process drifts the
+    unique_name slot counters (ar1.w_velocity_0 -> _1), exactly like a
+    per-stage pipeline program differing across worlds. The manifest's
+    slot descriptors let _maybe_resume re-key the saved velocity onto
+    the new build's names — bit-exact, old names dropped."""
+    from paddle_tpu.contrib import CheckpointConfig, Trainer
+
+    train_func, _, reader, _ = _trainer_pieces()
+
+    def optimizer_func():
+        return fluid.optimizer.Momentum(0.1, momentum=0.9)
+
+    t1 = Trainer(train_func, optimizer_func, fluid.CPUPlace(),
+                 checkpoint_config=CheckpointConfig(
+                     str(tmp_path), epoch_interval=1))
+    t1.train(2, None, reader(), ["img", "label"])
+    old_names = sorted(n for n in t1._optimizer.slot_descriptor()
+                       if "velocity" in n)
+    assert old_names and all(n.endswith("_0") for n in old_names)
+    saved = {n: np.asarray(t1.scope.find_var(n)) for n in old_names}
+    assert any(np.abs(v).max() > 0 for v in saved.values())
+
+    t2 = Trainer(train_func, optimizer_func, fluid.CPUPlace(),
+                 checkpoint_config=CheckpointConfig(
+                     str(tmp_path), epoch_interval=1))
+    new_names = sorted(n for n in t2._optimizer.slot_descriptor()
+                       if "velocity" in n)
+    assert new_names != old_names  # the drift is real
+    for old, new in zip(old_names, new_names):
+        assert t2.scope.find_var(old) is None  # stale key dropped
+        np.testing.assert_array_equal(
+            np.asarray(t2.scope.find_var(new)), saved[old], err_msg=new)

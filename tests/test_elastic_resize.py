@@ -230,7 +230,7 @@ def _single_process_losses():
         import fleet_resize_worker as fw
     finally:
         sys.path.pop(0)
-    main, startup, loss = fw.build()
+    main, startup, loss, _opt = fw.build()
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
@@ -302,5 +302,123 @@ def test_fleet_8_to_4_shrink_restores_and_finishes(tmp_path):
         assert sorted(r["dead_seen"]) == [
             f"worker-{k}" for k in kill_ranks]
         np.testing.assert_allclose(r["losses"], single[kill_step:],
+                                   rtol=1e-4, atol=1e-5)
+    assert results[0]["losses"][-1] < single[0]  # learning resumed
+
+
+# --------------------------------------------------------------------------
+# the multi-process GROW drill (ISSUE 14 acceptance): 4 -> 8 mid-run,
+# newcomers warm-start from the compile-cache disk tier (zero fresh
+# compiles on rejoin), optimizer slot state reshards, loss parity
+# --------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_fleet_4_to_8_grow_warm_starts_and_matches_loss(tmp_path):
+    from paddle_tpu import native
+
+    if not native.available():
+        pytest.skip("native library not built")
+    n0, n_join, grow_step = 4, 4, 2
+    coord_ep = f"127.0.0.1:{_free_port()}"
+    env_base = {
+        **os.environ,
+        "PT_TRAINERS": str(n0),
+        "PT_COORD_ENDPOINT": coord_ep,
+        "PT_JAX_COORD_ENDPOINT": f"127.0.0.1:{_free_port()}",
+        "PT_RECOVER_PORT": str(_free_port()),
+        "PT_RECOVER_JAX_PORT": str(_free_port()),
+        "PT_CKPT_DIR": str(tmp_path / "ckpt"),
+        # the warm-start tier every generation shares: incumbents
+        # populate it cold in generation 0, EVERYONE (newcomers
+        # included) must resolve from it in generation 1 (telemetry on
+        # so the workers' hit/miss accounting actually counts)
+        "PT_FLAGS_compile_cache_dir": str(tmp_path / "ccache"),
+        "PT_FLAGS_telemetry": "true",
+        # coordination-only fleet: this container's CPU jax cannot form
+        # a cross-process XLA world anyway (compute is replicated), and
+        # single-process jax gives every rank the SAME device identity
+        # — the condition (one shared local executable, the TPU-SPMD
+        # same-global-program analog) under which newcomers can
+        # warm-start incumbents' cache entries
+        "PT_COORD_ONLY": "1",
+        "JAX_PLATFORMS": "",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(HERE), os.environ.get("PYTHONPATH", "")]
+        ),
+    }
+    os.makedirs(tmp_path / "ckpt", exist_ok=True)
+    procs = []
+    for rank in range(n0):  # the generation-0 incumbents
+        env = {**env_base, "PT_TRAINER_ID": str(rank),
+               "PT_GROW_AT_STEP": str(grow_step),
+               "PT_EXPECT_JOINERS": str(n_join)}
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "fleet_resize_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        ))
+    join_procs = []
+    for j in range(n_join):  # the newcomers: announce + wait for plan
+        env = {**env_base, "PT_JOIN_ID": str(j),
+               "PT_JOIN_TARGET": coord_ep}
+        env.pop("PT_TRAINER_ID", None)
+        join_procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "fleet_resize_worker.py")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        ))
+
+    def _collect(p, who):
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"{who} failed:\n{out}\n{err}"
+        return out, err
+
+    results, resize_plans, join_results = {}, [], []
+    for rank, p in enumerate(procs):
+        out, _err = _collect(p, f"incumbent {rank}")
+        plan = [l for l in out.splitlines()
+                if l.startswith("RESIZE_PLAN ")]
+        assert plan, f"incumbent {rank} never planned the grow:\n{out}"
+        resize_plans.append(json.loads(plan[-1][len("RESIZE_PLAN "):]))
+        line = [l for l in out.splitlines()
+                if l.startswith("FLEET_RESULT ")]
+        assert line, f"no result line from incumbent {rank}:\n{out}"
+        r = json.loads(line[-1][len("FLEET_RESULT "):])
+        results[r["rank"]] = r
+    for j, p in enumerate(join_procs):
+        out, _err = _collect(p, f"joiner {j}")
+        jline = [l for l in out.splitlines()
+                 if l.startswith("JOIN_RESULT ")]
+        assert jline, f"joiner {j} never admitted:\n{out}"
+        join_results.append(json.loads(jline[-1][len("JOIN_RESULT "):]))
+        line = [l for l in out.splitlines()
+                if l.startswith("FLEET_RESULT ")]
+        assert line, f"no result line from joiner {j}:\n{out}"
+        r = json.loads(line[-1][len("FLEET_RESULT "):])
+        results[r["rank"]] = r
+
+    # every participant reached generation 1 of the 8-world
+    assert set(results) == set(range(n0 + n_join))
+    # every incumbent derived the SAME grow plan (direction metered)
+    assert all(pl["direction"] == "grow" and pl["world"] == 8
+               and pl["joins"] == [0, 1, 2, 3] for pl in resize_plans)
+    # joiners were assigned the ranks after the survivors, and the
+    # join-latency histogram observed each admission
+    assert sorted(jr["rank"] for jr in join_results) == [4, 5, 6, 7]
+    assert all(jr["join_latency_s"] >= 0 for jr in join_results)
+
+    single = _single_process_losses()
+    for r in results.values():
+        assert r["gen"] == 1 and r["world"] == 8
+        assert r["start_step"] == grow_step
+        # THE warm-start acceptance: generation 1 resolved every
+        # executable from the disk tier — zero fresh compiles on rejoin
+        assert r["ccache"]["misses"] == 0, r
+        assert r["ccache"]["hits"] >= 2, r  # startup + train step
+        assert all(v == 0 for v in r["ccache"]["errors"].values()), r
+        # loss parity vs the uninterrupted run: parameters AND Momentum
+        # velocity state survived the grow (a dropped velocity diverges
+        # the very first resumed step)
+        np.testing.assert_allclose(r["losses"], single[grow_step:],
                                    rtol=1e-4, atol=1e-5)
     assert results[0]["losses"][-1] < single[0]  # learning resumed

@@ -376,6 +376,57 @@ def test_serve_metrics_and_route(weights):
         flags.set_flags({"telemetry": False})
 
 
+def test_engine_lifecycle_state_on_monitor_plane(weights):
+    """ISSUE 14 serving tie-in: a replica being rotated out is
+    observable BEFORE its queue is torn down — the engine lifecycle
+    (serving -> draining -> closed) surfaces as the
+    pt_serve_engine_state gauge, the /serve stats row, and per-engine
+    rows on /healthz (a load balancer's probe must see 'draining' and
+    stop routing while in-flight requests finish)."""
+    cfg, scope = weights
+    flags.set_flags({"telemetry": True})
+    try:
+        eng = serving.ServingEngine(cfg, scope, slots=1, src_len=8,
+                                    max_len=8)
+        eid = str(eng.engine_id)
+
+        def _gauge():
+            return monitor.gauge("pt_serve_engine_state").value(
+                labels={"engine": eid})
+
+        def _healthz(port):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+                return json.loads(r.read())
+
+        assert eng.state == "serving" and _gauge() == 0
+        assert eng.stats()["state"] == "serving"
+        port = monitor.serve(port=0)
+        try:
+            assert _healthz(port)["engines"][eid] == "serving"
+            req = eng.submit(_srcs(1, seed=4)[0])
+            eng.drain()
+            # drained with the request finished; the engine stays
+            # draining (rotated out, not yet torn down) and says so
+            assert req.done
+            assert eng.state == "draining" and _gauge() == 1
+            assert _healthz(port)["engines"][eid] == "draining"
+            with pytest.raises(serving.EngineClosed):
+                eng.submit(_srcs(1, seed=5)[0])
+            eng.close()
+            assert eng.state == "closed" and _gauge() == 2
+            assert _healthz(port)["engines"][eid] == "closed"
+            # idempotent shutdown: drain() on a closed engine must not
+            # regress the published lifecycle closed -> draining
+            assert eng.drain() is True
+            assert eng.state == "closed" and _gauge() == 2
+            assert _healthz(port)["engines"][eid] == "closed"
+        finally:
+            monitor.stop_server()
+    finally:
+        flags.set_flags({"telemetry": False})
+
+
 # --------------------------------------------------------------------------
 # int8 PTQ artifact as a deployable weight source
 # --------------------------------------------------------------------------
