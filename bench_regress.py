@@ -44,6 +44,11 @@ FAMILY_TOLERANCE: Dict[str, float] = {
     # gates under the union-baseline rules from its first committed
     # round onward
     "serving_decode_tokens_per_sec": 0.15,
+    # the degraded-mode serving row (bench_serving.py: the same sweep
+    # under a seeded serve.decode delay fault at 1% of steps) measures
+    # resilience overhead; the injected delays add sampling noise on
+    # top of the host jitter, so it gets the widest envelope
+    "serving_degraded_tokens_per_sec": 0.20,
 }
 
 # Deliberately dropped families: a gated metric carried by ANY history

@@ -16,11 +16,18 @@ solo throughput by at least S/2; >1.0 beats it). The solo row, the full
 sweep, and the decode-loop executor-cache accounting (zero fresh
 compiles after warmup is the acceptance bar) ride along.
 
+A **degraded-mode** row rides along: the full-concurrency sweep is
+re-run under a seeded chaos plan delaying 1% of decode steps by 5x the
+healthy p50 (``serve.decode:delay(...)@p0.01``) — tokens/s + p99 under
+fault-injection overhead is tracked by bench_regress.py
+(``serving_degraded_tokens_per_sec``), so resilience cost is measured,
+not guessed.
+
 Env knobs: ``PT_BENCH_CPU=1`` forces the CPU backend;
 ``PT_BENCH_SERVE_SIZE=tiny|base`` picks the model (tiny for CPU smokes);
 ``PT_BENCH_SERVE_SLOTS`` (default 8), ``PT_BENCH_SERVE_SRC`` source
 length (default 32), ``PT_BENCH_SERVE_NEW`` max new tokens per request
-(default 24).
+(default 24); ``PT_BENCH_SERVE_DEGRADED=0`` skips the degraded row.
 """
 
 from __future__ import annotations
@@ -149,6 +156,32 @@ def main():
     full = sweep[f"c{SLOTS}"]
     speedup = (full["tokens_per_sec"] / solo["tokens_per_sec"]
                if solo["tokens_per_sec"] else 0.0)
+
+    # degraded mode: the same full-concurrency level under a seeded
+    # chaos plan delaying 1% of decode steps by 5x the healthy p50 —
+    # the resilience-overhead row bench_regress gates
+    degraded = None
+    if os.environ.get("PT_BENCH_SERVE_DEGRADED", "1") == "1":
+        from paddle_tpu import faults
+
+        delay_s = round(max(0.002, full["token_ms_p50"] / 1e3 * 5.0), 4)
+        faults.arm(f"serve.decode:delay({delay_s})@p0.01", seed=1234)
+        try:
+            row = _sweep_level(cfg, scope, SLOTS, 2 * SLOTS, monitor)
+        finally:
+            faults.disarm()
+        log(f"degraded (delay {delay_s}s @ 1% of decode steps): {row}")
+        degraded = {
+            "metric": "serving_degraded_tokens_per_sec",
+            "value": row["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "token_ms_p99": row["token_ms_p99"],
+            "delay_s": delay_s,
+            "fault_rate": 0.01,
+            "vs_healthy": (round(row["tokens_per_sec"]
+                                 / full["tokens_per_sec"], 3)
+                           if full["tokens_per_sec"] else 0.0),
+        }
     print(json.dumps({
         "metric": "serving_decode_tokens_per_sec",
         "value": full["tokens_per_sec"],
@@ -166,6 +199,7 @@ def main():
         "token_ms_p99": full["token_ms_p99"],
         "ttft_ms_p50": full["ttft_ms_p50"],
         "fresh_compiles_after_warmup": full["fresh_compiles_after_warmup"],
+        "degraded": degraded,
         "sweep": sweep,
     }))
 

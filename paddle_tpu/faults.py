@@ -191,11 +191,26 @@ BUILTIN_SITES = {
                      "ServingEngine.submit; raise = failed admission "
                      "path — the request must surface the error, not "
                      "hang)",
+    "serve.prefill": "serving admission, pre-prefill of the popped "
+                     "request (serving.py _admit; raise = torn "
+                     "admission — the handle finishes 'error' before "
+                     "the exception propagates, the engine keeps "
+                     "serving)",
     "serve.decode": "serving decode loop, pre-dispatch of each "
-                    "single-token step (serving.py; delay = a stalled "
-                    "decode loop for SLO drills; raise fires BEFORE the "
-                    "step so device KV state stays consistent and the "
-                    "engine can keep serving)",
+                    "single-token step (serving.py; delay = a "
+                    "stalled/wedged decode loop for SLO + supervisor "
+                    "drills; raise(slot=N[,M]) = a CONTAINED poisoned-"
+                    "slot fault — only the named slots are evicted "
+                    "(outcome 'evicted', partial output kept) and the "
+                    "engine keeps decoding; a raise WITHOUT a slot hint "
+                    "drills an unattributable device error: the engine "
+                    "fails and an EngineSupervisor warm-restarts it)",
+    "serve.fetch": "serving token materialization, pre-wait of the "
+                   "double-buffered decode step's LazyFetches "
+                   "(serving.py _process_ready; raise(slot=N) = "
+                   "contained eviction with the step's remaining "
+                   "fetches retried once; unhinted raise = engine-"
+                   "fatal, the supervisor-restart seam)",
 }
 
 

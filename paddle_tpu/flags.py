@@ -194,6 +194,43 @@ _DEFS: Dict[str, tuple] = {
     # (outcome 'expired', partial output kept); 0 = no deadline. A
     # submit(deadline_ms=) overrides per request.
     "serve_deadline_ms": (int, 0, "default serving request deadline"),
+    # deadline-aware admission control (serving.py): when a request
+    # carries a deadline and the engine's measured per-token latency x
+    # its estimated queue position says even the FIRST token cannot land
+    # before it, submit() refuses the request up front (outcome
+    # 'rejected_early', DeadlineUnmeetable raised) instead of queueing
+    # doomed work
+    "serve_admission_control": (bool, True,
+                                "refuse unmeetable-deadline requests at "
+                                "submit time"),
+    # EngineSupervisor wedge detection: a busy engine whose decode-loop
+    # heartbeat is older than this is declared wedged, torn down and
+    # warm-restarted through the persistent compile cache; declaring a
+    # wedge also emits a monitor stall record for site "serve.decode"
+    # (per-dispatch stall_guard deadlines stay on the global
+    # stall_timeout_ms flag)
+    "serve_wedge_timeout_ms": (int, 30_000,
+                               "supervised-engine wedge-detection "
+                               "deadline"),
+    # lifetime restart budget for one EngineSupervisor: past it the
+    # supervisor gives up, finishes every pending handle with outcome
+    # 'error' and closes (a permanently failing engine must not restart
+    # forever)
+    "serve_max_restarts": (int, 3, "EngineSupervisor restart budget"),
+    # serving brownout: once the request queue has held at least
+    # queue_factor x serve_queue_depth entries for window consecutive
+    # scheduler ticks, new admissions have max_new_tokens capped at
+    # brownout_max_new_tokens — the engine sheds tokens per request
+    # instead of letting queue latency collapse; 0 factor = brownout off
+    "serve_brownout_queue_factor": (float, 0.0,
+                                    "queue-saturation fraction that "
+                                    "engages brownout (0 = off)"),
+    "serve_brownout_window": (int, 16,
+                              "consecutive saturated ticks before "
+                              "brownout engages"),
+    "serve_brownout_max_new_tokens": (int, 16,
+                                      "max_new_tokens cap applied to "
+                                      "admissions during brownout"),
     # unified retry policy (retry.py) used by fleet connect/kv/heartbeat:
     # first backoff sleep; subsequent sleeps take decorrelated jitter in
     # [base, 3*prev] capped at retry_max_delay_ms

@@ -242,6 +242,19 @@ class Predictor:
                                      max_batch_size))
 
 
+    def serving_engine(self, cfg, *, supervised: bool = True, **kwargs):
+        """Open a continuous-batching serving engine over this
+        predictor's weights (serving.py; the reference parity point is
+        AnalysisPredictor as a LONG-LIVED self-healing server process).
+        ``supervised=True`` (default) wraps it in an EngineSupervisor —
+        decode-loop thread, wedge watchdog, warm restart through the
+        persistent compile cache; pass False for a caller-driven
+        ServingEngine. ``cfg`` is the transformer config; ``kwargs``
+        are the engine geometry/SLO knobs (slots, src_len, ...)."""
+        from paddle_tpu import serving as _serving
+
+        return _serving.serve(cfg, self, supervised=supervised, **kwargs)
+
     def close(self):
         """Release the predictor's compiled entries + staged feeds
         (mirroring ``Executor.close`` scoped to this predictor's private

@@ -971,7 +971,8 @@ def build_decode_step(cfg: Optional[TransformerConfig] = None,
     control — a slot the host has evicted decodes as dead whatever the
     device live flag says). Fetches: emitted token [slots] int64, live
     [slots] bool (False = finished: EOS or length cap), position
-    [slots] int64 of the emitted token."""
+    [slots] int64 of the emitted token, and max |logit| per slot
+    (f32 — non-finite marks the slot poisoned; serving evicts it)."""
     from paddle_tpu.layer_helper import LayerHelper
 
     cfg = cfg or base()
@@ -1052,9 +1053,13 @@ def build_decode_step(cfg: Optional[TransformerConfig] = None,
         x, cfg.trg_vocab_size, num_flatten_dims=2,
         param_attr=ParamAttr(name="proj_colp.w"), bias_attr=False,
     )
-    nxt = layers.argmax(layers.reshape(logits, [slots,
-                                                cfg.trg_vocab_size]),
-                        axis=-1)  # [S] int64, greedy
+    flat = layers.reshape(logits, [slots, cfg.trg_vocab_size])
+    nxt = layers.argmax(flat, axis=-1)  # [S] int64, greedy
+    # per-slot poison probe: max |logit| per slot (NaN/Inf propagate
+    # through the max) — the serving plane checks np.isfinite on the
+    # host and evicts ONLY the poisoned slot(s), the decode-path twin of
+    # the numerics plane's nonfinite/maxabs reduction
+    maxabs = layers.reduce_max(layers.abs(flat), dim=1)  # [S] f32
 
     # liveness: host mask AND device EOS/length tracking. A dead slot
     # freezes (emits end_id, position pinned) until the next prefill
@@ -1072,7 +1077,46 @@ def build_decode_step(cfg: Optional[TransformerConfig] = None,
     layers.assign(emit_pos, output=pos)
     layers.assign(new_live, output=live)
     return {"feeds": [active], "emit": emit, "live": new_live,
-            "pos": emit_pos, "state": state, "config": cfg}
+            "pos": emit_pos, "maxabs": maxabs, "state": state,
+            "config": cfg}
+
+
+def build_slot_scrub(cfg: Optional[TransformerConfig] = None,
+                     slots: int = 4, src_len: int = 32,
+                     max_len: int = 32):
+    """Zero ONE slot's row in every device-resident serving tensor, on
+    device (serving.py's poisoned-slot eviction: a stale non-finite K/V
+    row would re-poison the slot's next occupant through the softmax
+    mask, and a host round-trip of the full caches to zero one row
+    would stall the decode loop). Feed: slot [1] int64. No fetches —
+    like prefill, a pure device-state update."""
+    from paddle_tpu.layer_helper import LayerHelper
+
+    cfg = cfg or base()
+    slot = layers.data("slot", shape=[1], dtype="int64",
+                       append_batch_size=False)
+    state = _serve_state_vars(cfg, slots, src_len, max_len)
+    helper = LayerHelper("slot_scrub")
+    for name, (shape, dtype) in serving_state_specs(
+            cfg, slots, src_len, max_len).items():
+        var = state[name]
+        if len(shape) == 1:
+            # per-slot scalar (cur/pos/live): scatter one zero element
+            new = layers.scatter(
+                var, slot, layers.fill_constant([1], dtype, 0.0))
+            layers.assign(new, output=var)
+        else:
+            # cache row: cache[slot] = zeros(shape[1:]) (the prefill
+            # _slot_update idiom)
+            zero = layers.fill_constant(list(shape[1:]), dtype, 0.0)
+            out = helper.create_variable_for_type_inference(var.dtype,
+                                                            True)
+            helper.append_op(
+                "dynamic_update",
+                inputs={"X": var, "Index": slot, "Value": zero},
+                outputs={"Out": out})
+            layers.assign(out, output=var)
+    return {"feeds": [slot], "state": state, "config": cfg}
 
 
 _serving_prog_cache: Dict[tuple, dict] = {}
@@ -1094,15 +1138,20 @@ def build_serving(cfg: TransformerConfig, slots: int, src_len: int,
     if cached is not None:
         return cached
     prefill_prog, decode_prog = fluid.Program(), fluid.Program()
+    scrub_prog = fluid.Program()
     with fluid.program_guard(prefill_prog, fluid.Program()):
         prefill = build_prefill(cfg, slots=slots, src_len=src_len,
                                 max_len=max_len, bos_id=bos_id)
     with fluid.program_guard(decode_prog, fluid.Program()):
         decode = build_decode_step(cfg, slots=slots, src_len=src_len,
                                    max_len=max_len, end_id=end_id)
+    with fluid.program_guard(scrub_prog, fluid.Program()):
+        scrub = build_slot_scrub(cfg, slots=slots, src_len=src_len,
+                                 max_len=max_len)
     entry = {
         "prefill_program": prefill_prog, "prefill": prefill,
         "decode_program": decode_prog, "decode": decode,
+        "scrub_program": scrub_prog, "scrub": scrub,
         "state_specs": serving_state_specs(cfg, slots, src_len, max_len),
         "config": cfg,
     }

@@ -1637,7 +1637,7 @@ def _oom_counter():
         _M_OOM = counter(
             "pt_oom_events_total",
             "RESOURCE_EXHAUSTED failures captured by the OOM forensics "
-            "hook, by phase (compile/run/fetch/prefetch)")
+            "hook, by phase (compile/run/fetch/prefetch/serve)")
     return _M_OOM
 
 

@@ -446,6 +446,43 @@ def _decode(program, plan, bundle, step, kind, nan_step):
     return summary
 
 
+def note_nonfinite(op_type: str, var: str, count: float = 1.0, *,
+                   program_uid: int = -1, step: int = -1,
+                   kind: str = "step",
+                   maxabs: float = float("nan"),
+                   rms: float = float("nan")):
+    """Host-side non-finite report from a plane that detects poison
+    OUTSIDE the in-graph bundle (e.g. serving.py's per-slot decode
+    probe): counts ``pt_nonfinite_total{op=,var=}`` and appends a
+    provenance record so the episode shows on ``/numerics`` beside the
+    instrumented-program ones. Gated on telemetry; never raises."""
+    if not _monitor.enabled():
+        return
+    try:
+        _M_NONFINITE.inc(float(count), labels={"op": op_type, "var": var})
+        rec = {
+            "v": PROVENANCE_SCHEMA_VERSION,
+            "ts": time.time(),
+            "step": int(step),
+            "kind": kind,
+            "program": f"program{program_uid}",
+            "program_uid": int(program_uid),
+            "op_idx": -1,  # host-side detection: no in-graph op index
+            "op_type": op_type,
+            "var": var,
+            "nonfinite": float(count),
+            "maxabs": float(maxabs),
+            "rms": float(rms),
+            "nan_step": None,
+        }
+        with _LOCK:
+            _PROVENANCE.append(rec)
+    except Exception as e:
+        import warnings
+
+        warnings.warn(f"nonfinite note dropped: {e!r}", RuntimeWarning)
+
+
 # ---------------------------------------------------------------------------
 # inspection surface (/numerics route, debugger annotations, tests)
 # ---------------------------------------------------------------------------

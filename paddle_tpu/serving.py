@@ -1,11 +1,12 @@
 """Production inference serving plane: continuous batching over an
-on-device KV cache.
+on-device KV cache, with fault containment and supervised self-healing.
 
 Reference seam: the AnalysisPredictor C-API (inference.py) serves one
 request batch per call; real serving traffic is a stream of requests of
 different lengths arriving at different times. The reference framework
-dedicates its ``inference_transpiler``/server layer to this; here the
-serving plane is built on the pieces the training stack already proved:
+dedicates its ``inference_transpiler``/server layer to this — a
+long-lived, self-healing predictor process; here the serving plane is
+built on the pieces the training stack already proved:
 
 - **Continuous batch assembly**: a bounded request queue feeds a fixed
   set of batch *slots*. Requests are admitted and evicted at token
@@ -28,7 +29,44 @@ serving plane is built on the pieces the training stack already proved:
 - **SLO plane for free**: ``pt_serve_*`` metrics (queue depth, tokens/s,
   TTFT + per-token latency histograms) ride the monitor registry; the
   live endpoint serves an engine summary at ``/serve``; chaos plans can
-  arm ``serve.enqueue`` / ``serve.decode`` fault sites.
+  arm ``serve.enqueue`` / ``serve.prefill`` / ``serve.decode`` /
+  ``serve.fetch`` fault sites.
+
+Resilience (the serving analog of the training fault-tolerance plane):
+
+- **Decode fault containment**: a decode/fetch failure that names its
+  poisoned slot(s) (``slot=N`` in the error text — the chaos-plan
+  ``raise(slot=N)`` protocol, and the shape a per-slot device error
+  report takes) evicts ONLY those slots: the request finishes with
+  outcome ``evicted`` keeping its partial output, the slot's device
+  rows are scrubbed (a NaN K/V row would re-poison the next occupant
+  through the softmax mask: 0 * NaN = NaN), and every healthy slot
+  keeps decoding byte-identically. Non-finite logits are caught per
+  slot via the decode program's max-|logit| probe and contained the
+  same way (outcome ``error``; reported through the numerics plane).
+  An UNATTRIBUTABLE failure (no slot hint, or RESOURCE_EXHAUSTED —
+  which additionally runs OOM forensics with ``phase="serve"``) fails
+  the engine: device state can no longer be trusted.
+- **Supervised warm restart**: ``EngineSupervisor`` owns the engine, a
+  decode-loop thread, and a watchdog riding engine heartbeats (a wedge
+  declaration also emits a ``monitor`` stall record for site
+  ``serve.decode``). A crashed (engine-fatal error) or wedged
+  (heartbeat older than ``serve_wedge_timeout_ms`` while busy) engine
+  is torn down and rebuilt through the persistent compile cache (zero
+  fresh compiles — the warm-replica path), and every surviving queued +
+  in-flight request is re-prefilled under a retry.py budget; greedy
+  decode is deterministic, so replayed requests produce byte-identical
+  tokens. Metered by ``pt_serve_engine_restarts_total`` and
+  ``pt_serve_requests_replayed_total``.
+- **Overload protection**: deadline-aware admission control refuses a
+  request at submit() when the measured per-token latency (EWMA of
+  decode-step wall time) times its estimated queue position says even
+  the first token cannot land before the deadline (outcome
+  ``rejected_early``, DeadlineUnmeetable raised — the request is never
+  queued); and a brownout mode (``serve_brownout_*`` flags) caps
+  admissions' ``max_new_tokens`` under sustained queue saturation, so
+  the engine degrades tokens-per-request instead of letting queue
+  latency collapse.
 
 Deployable artifacts: an engine loads weights from a live Scope, a
 Predictor, or a saved inference-model directory — including the int8 PTQ
@@ -42,8 +80,10 @@ from __future__ import annotations
 import collections
 import itertools
 import os
+import re
 import threading
 import time
+import warnings
 import weakref
 from typing import Dict, List, Optional, Sequence
 
@@ -52,6 +92,8 @@ import numpy as np
 from paddle_tpu import faults as _faults
 from paddle_tpu import flags as _flags
 from paddle_tpu import monitor as _monitor
+from paddle_tpu import numerics as _numerics
+from paddle_tpu import retry as _retry
 from paddle_tpu.executor import Executor, Scope, scope_guard
 from paddle_tpu.framework import CPUPlace, TPUPlace
 
@@ -60,7 +102,7 @@ from paddle_tpu.framework import CPUPlace, TPUPlace
 _M_REQUESTS = _monitor.counter(
     "pt_serve_requests_total",
     "serving requests by terminal outcome (completed / length / "
-    "expired / rejected / drained / error)")
+    "expired / rejected / rejected_early / drained / error / evicted)")
 _M_QUEUE_DEPTH = _monitor.gauge(
     "pt_serve_queue_depth", "requests waiting for a batch slot")
 _M_SLOTS_ACTIVE = _monitor.gauge(
@@ -85,53 +127,120 @@ _M_TTFT_SECONDS = _monitor.histogram(
 _M_ENGINE_STATE = _monitor.gauge(
     "pt_serve_engine_state",
     "per-engine lifecycle state by engine id: 0=serving, 1=draining, "
-    "2=closed — a replica being rotated out is observable BEFORE its "
-    "queue is torn down")
+    "2=closed, 3=failed — a replica being rotated out (or killed by a "
+    "decode fault) is observable BEFORE its queue is torn down; closed "
+    "rows age out after ENGINE_STATE_TTL_S")
+_M_SLOT_EVICTIONS = _monitor.counter(
+    "pt_serve_slot_evictions_total",
+    "poisoned batch slots evicted by decode fault containment, by "
+    "cause (fault = slot-hinted decode/fetch error, nonfinite = "
+    "non-finite logits caught by the per-slot probe); the request "
+    "keeps its partial output and every healthy slot keeps decoding")
+_M_RESTARTS = _monitor.counter(
+    "pt_serve_engine_restarts_total",
+    "supervised warm engine restarts (crashed or wedged decode loop "
+    "torn down and rebuilt through the persistent compile cache)")
+_M_REPLAYED = _monitor.counter(
+    "pt_serve_requests_replayed_total",
+    "queued + in-flight requests re-prefilled onto the restarted "
+    "engine after a supervised restart (greedy decode is "
+    "deterministic: a replay returns byte-identical tokens)")
+_M_BROWNOUT = _monitor.gauge(
+    "pt_serve_brownout_engines",
+    "engines currently in brownout (sustained queue saturation: "
+    "admissions' max_new_tokens capped by "
+    "serve_brownout_max_new_tokens)")
+_M_BROWNOUT_CAPPED = _monitor.counter(
+    "pt_serve_brownout_capped_total",
+    "admissions whose max_new_tokens was cut by an engaged brownout")
 
-ENGINE_STATES = ("serving", "draining", "closed")
-# engine id -> lifecycle state, bounded (closed engines age out so the
-# /healthz payload and the gauge's label set stay small). Mutated by
-# engine threads and iterated by the monitor server's handler threads:
-# every access holds _ENGINE_STATE_LOCK.
+ENGINE_STATES = ("serving", "draining", "closed", "failed")
+# Terminal 'closed' rows age out of the /healthz payload and the gauge
+# after this many seconds (a rotated replica's state is liveness
+# information for a while, not forever). Tests may override.
+ENGINE_STATE_TTL_S = 300.0
+# engine id -> (lifecycle state, transition ts), bounded (closed engines
+# age out so the /healthz payload and the gauge's label set stay small).
+# Mutated by engine threads and iterated by the monitor server's handler
+# threads: every access holds _ENGINE_STATE_LOCK.
 _ENGINE_STATE_CAP = 32
 _ENGINE_STATE_LOCK = threading.Lock()
-_ENGINE_STATES: "collections.OrderedDict[int, str]" = \
+_ENGINE_STATES: "collections.OrderedDict[int, tuple]" = \
     collections.OrderedDict()
+
+
+def _sweep_engine_states_locked():
+    """Drop terminal 'closed' rows older than ENGINE_STATE_TTL_S.
+    Caller holds _ENGINE_STATE_LOCK; returns True when rows dropped."""
+    now = time.monotonic()
+    stale = [k for k, (state, ts) in _ENGINE_STATES.items()
+             if state == "closed" and now - ts > ENGINE_STATE_TTL_S]
+    for k in stale:
+        del _ENGINE_STATES[k]
+    return bool(stale)
+
+
+def _publish_engine_states(snapshot):
+    # the gauge mirrors the bounded map wholesale (Gauge.replace, its
+    # own atomic swap): engines aged/evicted out of the map drop their
+    # cells too, so a process churning many short-lived engines never
+    # accretes stale labels
+    _M_ENGINE_STATE.replace(
+        [({"engine": str(k)}, float(ENGINE_STATES.index(state)))
+         for k, (state, _ts) in snapshot])
 
 
 def _note_engine_state(engine_id: int, state: str):
     with _ENGINE_STATE_LOCK:
-        _ENGINE_STATES[engine_id] = state
+        _ENGINE_STATES[engine_id] = (state, time.monotonic())
         _ENGINE_STATES.move_to_end(engine_id)
+        _sweep_engine_states_locked()
         while len(_ENGINE_STATES) > _ENGINE_STATE_CAP:
             _ENGINE_STATES.popitem(last=False)
-        snapshot = list(_ENGINE_STATES.items())
-    # the gauge mirrors the bounded map wholesale (Gauge.replace, its
-    # own atomic swap): engines aged out of the map drop their cells
-    # too, so a process churning many short-lived engines never
-    # accretes stale labels
-    _M_ENGINE_STATE.replace(
-        [({"engine": str(k)}, float(ENGINE_STATES.index(v)))
-         for k, v in snapshot])
+        # publish INSIDE the lock: a concurrent publisher holding a
+        # stale snapshot could otherwise overwrite a newer transition
+        # (lock order is always state lock -> monitor registry lock)
+        _publish_engine_states(list(_ENGINE_STATES.items()))
 
 
 def engine_states() -> Dict[str, str]:
-    """{engine id -> "serving" | "draining" | "closed"} for the
-    /healthz monitor route: a serving replica's lifecycle is liveness
-    information — a load balancer must stop routing to a draining
-    engine before its queue disappears."""
+    """{engine id -> "serving" | "draining" | "closed" | "failed"} for
+    the /healthz monitor route: a serving replica's lifecycle is
+    liveness information — a load balancer must stop routing to a
+    draining (or failed) engine before its queue disappears. Closed
+    rows age out after ENGINE_STATE_TTL_S so a rotated replica's
+    terminal state is not served forever."""
     with _ENGINE_STATE_LOCK:
-        return {str(k): v for k, v in _ENGINE_STATES.items()}
+        swept = _sweep_engine_states_locked()
+        snapshot = list(_ENGINE_STATES.items())
+        if swept:
+            _publish_engine_states(snapshot)
+    return {str(k): state for k, (state, _ts) in snapshot}
 
-# chaos hooks (faults.py): a raise at serve.enqueue drills queue-path
-# failures, a delay/raise at serve.decode drills a stalled/failed decode
-# loop (the fault fires BEFORE the step dispatch, so device state stays
-# consistent and the engine can keep serving after the drill)
+# chaos hooks (faults.py): serve.enqueue drills queue-path failures;
+# serve.prefill tears the admission seam; serve.decode drills the
+# decode loop (delay = wedge, raise(slot=N) = contained poisoned slot,
+# unhinted raise = engine-fatal); serve.fetch tears the async
+# materialization seam the same way.
 _F_ENQUEUE = _faults.site("serve.enqueue")
+_F_PREFILL = _faults.site("serve.prefill")
 _F_DECODE = _faults.site("serve.decode")
+_F_FETCH = _faults.site("serve.fetch")
 
 REQUEST_OUTCOMES = ("completed", "length", "expired", "rejected",
-                    "drained", "error")
+                    "rejected_early", "drained", "error", "evicted")
+
+# poisoned-slot attribution in a decode/fetch error's text: the chaos
+# plan's raise(slot=N[,M]) protocol, and the shape a real per-slot
+# device error report takes. No match = unattributable = engine-fatal.
+_SLOT_HINT_RE = re.compile(r"slots?\s*[=:]\s*(\d+(?:\s*,\s*\d+)*)")
+
+
+def _slot_hints(exc) -> Optional[List[int]]:
+    m = _SLOT_HINT_RE.search(str(exc))
+    if m is None:
+        return None
+    return sorted({int(p) for p in m.group(1).split(",")})
 
 
 class QueueFull(RuntimeError):
@@ -140,6 +249,25 @@ class QueueFull(RuntimeError):
 
 class EngineClosed(RuntimeError):
     """submit()/step() on a closed engine."""
+
+
+class EngineFailed(RuntimeError):
+    """The engine hit an unattributable decode/fetch failure: device
+    state can no longer be trusted, only a (supervised) rebuild can
+    serve again. ``submit()``/``step()`` raise this until close()."""
+
+
+class DeadlineUnmeetable(RuntimeError):
+    """Deadline-aware admission control refused the request at submit:
+    measured per-token latency x estimated queue position says even the
+    first token cannot land before the deadline. The handle is finished
+    with outcome ``rejected_early`` and never queued."""
+
+    def __init__(self, message: str, request=None,
+                 estimate_s: Optional[float] = None):
+        super().__init__(message)
+        self.request = request
+        self.estimate_s = estimate_s
 
 
 class ServeRequest:
@@ -160,6 +288,12 @@ class ServeRequest:
         self.tokens: List[int] = []
         self.outcome: Optional[str] = None
         self.ttft_s: Optional[float] = None
+        self.replays = 0  # supervised-restart replays of this request
+        self.capped = False  # max_new_tokens cut by brownout
+        # set by the supervisor's replay intake; the RESET (token wipe)
+        # is deferred to the rebuilt engine's admission so a replay
+        # that never reaches prefill keeps its partial output
+        self._replay_pending = False
         self._done = threading.Event()
 
     @property
@@ -178,6 +312,18 @@ class ServeRequest:
         self.outcome = outcome
         _M_REQUESTS.inc(labels={"outcome": outcome})
         self._done.set()
+
+    def _reset_for_replay(self):
+        """Applied at the rebuilt engine's ADMISSION (not at harvest —
+        a replay that is drained/errored before prefill must keep its
+        partial output): decode restarts from scratch (greedy is
+        deterministic — the final stream is byte-identical); TTFT
+        re-measures from the original submit."""
+        self._replay_pending = False
+        self.tokens = []
+        self.ttft_s = None
+        self.replays += 1
+        _M_REPLAYED.inc()
 
 
 def _load_weights_into(scope: Scope, weights) -> bool:
@@ -229,13 +375,16 @@ class ServingEngine:
     One engine = one model + one batch geometry: ``slots`` concurrent
     requests, sources padded/bucketed to ``src_len``, at most
     ``max_len - 1`` generated tokens per request. ``submit()`` enqueues
-    (with queue-depth backpressure and optional per-request deadlines);
-    the caller drives ``step()`` — or ``run_until_idle()`` — to make
-    progress; ``drain()`` stops admissions and finishes the in-flight
-    set; ``close()`` drains and releases the compiled entries. The
-    lifecycle (serving -> draining -> closed) is observable: ``state``
-    here, ``pt_serve_engine_state`` on /metrics, and per-engine rows on
-    the /healthz route (``engine_states``).
+    (with queue-depth backpressure, optional per-request deadlines, and
+    deadline-aware admission control); the caller drives ``step()`` —
+    or ``run_until_idle()`` — to make progress; ``drain()`` stops
+    admissions and finishes the in-flight set; ``close()`` drains and
+    releases the compiled entries. The lifecycle (serving -> draining
+    -> closed, or -> failed on an unattributable decode fault) is
+    observable: ``state`` here, ``pt_serve_engine_state`` on /metrics,
+    and per-engine rows on the /healthz route (``engine_states``). For
+    a self-healing engine, wrap it in ``EngineSupervisor`` (or
+    ``serve(..., supervised=True)``).
     """
 
     _eid = itertools.count(1)
@@ -274,10 +423,31 @@ class ServingEngine:
             self.scope.set(name, np.zeros(shape, dtype=np.dtype(dtype)))
         self._queue: "collections.deque[ServeRequest]" = collections.deque()
         self._slots = [_Slot() for _ in range(self.slots)]
-        self._pending = None  # (LazyFetches, per-slot request snapshot, t0)
+        # (LazyFetches, per-slot request snapshot, t0, retried)
+        self._pending = None
         self._lock = threading.Lock()
         self._draining = False
         self._closed = False
+        self._failed = False
+        self.last_error: Optional[str] = None
+        # decode-loop heartbeat (EngineSupervisor wedge detection) and
+        # the measured per-token latency estimator (admission control;
+        # EWMA of decode-step wall time, independent of telemetry)
+        self._beat = time.perf_counter()
+        self._token_ewma_s: Optional[float] = None
+        self._ewma_skipped_first = False
+        # recent decode-step walls (dispatch -> tokens on host), for
+        # the stats() latency row + overload drills; the first
+        # (compile-carrying) step is excluded like the EWMA
+        self._step_walls: "collections.deque[float]" = collections.deque(
+            maxlen=256)
+        # per-dispatch stall_guard deadline override; 0 = the global
+        # stall_timeout_ms flag (default 0 = disarmed, a shared
+        # nullcontext — the hot path stays Timer-free)
+        self.stall_deadline_ms = 0.0
+        # brownout (overload shedding) state
+        self.brownout = False
+        self._saturated_ticks = 0
         self.decode_steps = 0
         self.tokens_emitted = 0
         self.completed = 0
@@ -293,7 +463,10 @@ class ServingEngine:
                deadline_ms: Optional[float] = None) -> ServeRequest:
         """Enqueue a generation request. ``src_ids`` shorter than the
         engine's ``src_len`` is padded (mask derived); longer raises.
-        Backpressure: raises QueueFull beyond ``serve_queue_depth``."""
+        Backpressure: raises QueueFull beyond ``serve_queue_depth``;
+        a deadline the measured per-token latency says is unmeetable
+        raises DeadlineUnmeetable (outcome ``rejected_early``) without
+        queueing — see the ``serve_admission_control`` flag."""
         _F_ENQUEUE.hit()
         ids = np.asarray(src_ids, np.int64).reshape(-1)
         if ids.shape[0] > self.src_len:
@@ -335,6 +508,10 @@ class ServingEngine:
             # engine nobody will step again
             if self._closed:
                 raise EngineClosed("submit() on a closed engine")
+            if self._failed:
+                raise EngineFailed(
+                    f"submit() on a failed engine ({self.last_error}); "
+                    f"an EngineSupervisor would have restarted it")
             if self._draining:
                 raise EngineClosed("submit() on a draining engine")
             if len(self._queue) >= self.queue_depth:
@@ -342,9 +519,49 @@ class ServingEngine:
                 _publish_gauges()
                 raise QueueFull(
                     f"serving queue at capacity ({self.queue_depth})")
+            if (req.deadline_ts is not None
+                    and self._token_ewma_s is not None
+                    and _flags.get_flag("serve_admission_control")):
+                eta_s = self._estimate_first_token_s()
+                if req.submit_ts + eta_s > req.deadline_ts:
+                    # refused AT SUBMIT, never queued: queueing work
+                    # that provably cannot emit one token before its
+                    # deadline only inflates every neighbor's latency
+                    req._finish("rejected_early")
+                    _publish_gauges()
+                    raise DeadlineUnmeetable(
+                        f"deadline unmeetable: first token estimated "
+                        f"in {eta_s * 1e3:.1f} ms (measured "
+                        f"{self._token_ewma_s * 1e3:.2f} ms/token x "
+                        f"queue position) vs a "
+                        f"{(req.deadline_ts - req.submit_ts) * 1e3:.1f}"
+                        f" ms deadline", request=req, estimate_s=eta_s)
+            # the heartbeat also resets at WORK ARRIVAL — but only when
+            # the engine is truly IDLE: after an idle gap longer than
+            # the wedge timeout, the first submit flips busy() before
+            # the loop's next step() can beat (the watchdog would read
+            # the idle age as a wedge). An engine with work in flight
+            # gets no reset: steady submit traffic onto a genuinely
+            # wedged decode loop must not defer its detection.
+            idle = (not self._queue and self._pending is None
+                    and all(s.request is None for s in self._slots))
+            if idle:
+                self._beat = time.perf_counter()
             self._queue.append(req)
             _publish_gauges()
         return req
+
+    def _estimate_first_token_s(self) -> float:
+        """Estimated delay until a request submitted NOW sees its first
+        token: tokens still owed ahead of it (queue + in-flight),
+        drained ``slots`` at a time, at the measured per-token EWMA.
+        Caller holds the lock."""
+        backlog = sum(r.max_new_tokens for r in self._queue)
+        for s in self._slots:
+            r = s.request
+            if r is not None and r.outcome is None:
+                backlog += max(0, r.max_new_tokens - len(r.tokens))
+        return self._token_ewma_s * (backlog / float(self.slots) + 1.0)
 
     # --- the scheduler tick ---
 
@@ -356,11 +573,17 @@ class ServingEngine:
         of tokens handed out this tick."""
         if self._closed:
             raise EngineClosed("step() on a closed engine")
+        if self._failed:
+            raise EngineFailed(
+                f"step() on a failed engine ({self.last_error})")
+        self._beat = time.perf_counter()
+        self._brownout_tick()
         emitted = self._process_ready()
         self._admit()
         self._dispatch()
         if self.pipeline_depth == 0:
             emitted += self._process_ready()
+        self._beat = time.perf_counter()
         return emitted
 
     def run_until_idle(self, max_steps: int = 100_000) -> int:
@@ -381,6 +604,29 @@ class ServingEngine:
         return (queued or self._pending is not None
                 or any(s.request is not None for s in self._slots))
 
+    def heartbeat_age_s(self) -> float:
+        """Seconds since the decode loop last made progress (step entry
+        or completion) — the EngineSupervisor's wedge signal."""
+        return time.perf_counter() - self._beat
+
+    def request_drain(self) -> bool:
+        """The non-stepping front half of drain(): stop admissions and
+        finish every queued-but-unadmitted request with outcome
+        'drained'. The in-flight set keeps decoding (whoever drives
+        step() — the caller or a supervisor loop — finishes it).
+        Returns False when the engine is already closed."""
+        with self._lock:
+            if self._closed:
+                return False
+            # flag + queue sweep under one lock: a racing submit either
+            # landed (and is drained here) or raises EngineClosed
+            self._draining = True
+            while self._queue:
+                self._queue.popleft()._finish("drained")
+            _publish_gauges()
+            _note_engine_state(self.engine_id, "draining")
+        return True
+
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Graceful drain: stop admissions, finish the in-flight set.
         Queued-but-unadmitted requests finish with outcome 'drained'.
@@ -393,16 +639,18 @@ class ServingEngine:
                 # _closed with, so a drain racing a close cannot pass
                 # the check and then publish 'draining' afterwards)
                 return True
-            # flag + queue sweep under one lock: a racing submit either
-            # landed (and is drained here) or raises EngineClosed
-            self._draining = True
-            while self._queue:
-                self._queue.popleft()._finish("drained")
-            _publish_gauges()
-            _note_engine_state(self.engine_id, "draining")
+        if not self.request_drain():
+            return True
+        if self._failed:
+            # a failed engine cannot step: the queue is swept, the
+            # in-flight set is close()'s (or the supervisor's) problem
+            return not self.busy()
         t0 = time.perf_counter()
         while self.busy():
-            self.step()
+            try:
+                self.step()
+            except (EngineClosed, EngineFailed):
+                return False
             if time.perf_counter() - t0 > timeout_s:
                 return False
         return True
@@ -410,23 +658,33 @@ class ServingEngine:
     def close(self, drain_timeout_s: float = 30.0):
         """Drain, then release the engine's compiled entries + staged
         feeds and its device-resident state. A drain that times out
-        (stalled decode loop) must not strand callers: every still
-        in-flight handle is finished with outcome 'drained' (partial
-        output kept) so ``result()`` never blocks forever on a closed
+        (stalled decode loop) or a failed engine must not strand
+        callers: every still in-flight handle is finished — outcome
+        'drained' (partial output kept), or 'error' when the engine
+        failed — so ``result()`` never blocks forever on a closed
         engine."""
         if self._closed:
             return
-        self.drain(drain_timeout_s)
+        if not self._failed:
+            self.drain(drain_timeout_s)
         with self._lock:
             # under the same lock drain() checks: once this flips, a
             # concurrent drain can no longer publish 'draining' over
             # the terminal 'closed' state below
             self._closed = True
-        self._pending = None
-        for s in self._slots:
-            req, s.request = s.request, None
-            if req is not None and req.outcome is None:
-                req._finish("drained")
+            self._pending = None
+            leftovers = []
+            for s in self._slots:
+                req, s.request = s.request, None
+                if req is not None and req.outcome is None:
+                    leftovers.append(req)
+            while self._queue:
+                r = self._queue.popleft()
+                if r.outcome is None:
+                    leftovers.append(r)
+        outcome = "error" if self._failed else "drained"
+        for req in leftovers:
+            req._finish(outcome)
         self._exe.release_scope(self.scope)
         self.scope.clear()
         _ENGINES.discard(self)
@@ -440,6 +698,40 @@ class ServingEngine:
             [s.request is not None and s.request.outcome is None
              for s in self._slots], bool)
 
+    def _brownout_tick(self):
+        """Overload shedding: once the queue has held >= factor x
+        capacity entries for `serve_brownout_window` consecutive ticks,
+        cap admissions' max_new_tokens — degrade tokens-per-request
+        instead of letting queue latency collapse. Disengages as soon
+        as a tick sees the queue below the threshold."""
+        factor = float(_flags.get_flag("serve_brownout_queue_factor"))
+        if factor <= 0.0:
+            if self.brownout:
+                self.brownout = False
+                _publish_gauges()
+            self._saturated_ticks = 0
+            return
+        threshold = max(1, int(round(factor * self.queue_depth)))
+        with self._lock:
+            qlen = len(self._queue)
+        if qlen >= threshold:
+            self._saturated_ticks += 1
+            if (not self.brownout and self._saturated_ticks
+                    >= int(_flags.get_flag("serve_brownout_window"))):
+                self.brownout = True
+                warnings.warn(
+                    f"serving engine {self.engine_id}: brownout engaged "
+                    f"(queue held >= {threshold}/{self.queue_depth} for "
+                    f"{self._saturated_ticks} ticks); admissions capped "
+                    f"at {_flags.get_flag('serve_brownout_max_new_tokens')}"
+                    f" new tokens", RuntimeWarning)
+                _publish_gauges()
+        else:
+            self._saturated_ticks = 0
+            if self.brownout:
+                self.brownout = False
+                _publish_gauges()
+
     def _admit(self):
         """Admissions at the token boundary: free slot x queued request
         -> prefill. The prefill program executes after the already
@@ -450,7 +742,7 @@ class ServingEngine:
             if free is None:
                 return
             with self._lock:
-                if not self._queue:
+                if self._failed or not self._queue:
                     return
                 req = self._queue.popleft()
                 _publish_gauges()
@@ -458,8 +750,23 @@ class ServingEngine:
                     and time.perf_counter() > req.deadline_ts):
                 req._finish("expired")
                 continue
+            was_replay = req._replay_pending
+            if was_replay:
+                # the token wipe happens HERE, where the replay really
+                # re-enters decode — not at harvest time
+                req._reset_for_replay()
+            if self.brownout and not was_replay:
+                # replays are exempt: capping one would break the
+                # byte-identical-replay invariant (and could return
+                # fewer tokens than its pre-restart partial output)
+                cap = int(_flags.get_flag("serve_brownout_max_new_tokens"))
+                if cap >= 1 and req.max_new_tokens > cap:
+                    req.max_new_tokens = cap
+                    req.capped = True
+                    _M_BROWNOUT_CAPPED.inc()
             pre = self._progs["prefill"]
             try:
+                _F_PREFILL.hit()
                 with scope_guard(self.scope), \
                         _monitor.span("serve.prefill"):
                     self._exe.run(
@@ -471,11 +778,14 @@ class ServingEngine:
                                 np.asarray([free], np.int64),
                         },
                         fetch_list=[])
-            except Exception:
+            except Exception as e:
                 # the request is already off the queue and owns no slot:
                 # finish the handle before propagating — result() must
                 # never block forever on a failed admission
                 req._finish("error")
+                _monitor.maybe_record_oom(
+                    e, program=self._progs["prefill_program"],
+                    phase="serve")
                 raise
             self._slots[free].request = req
             _M_PREFILLS.inc()
@@ -484,62 +794,252 @@ class ServingEngine:
     def _dispatch(self):
         """Launch one single-token decode step for the active set (a
         no-op tick when every slot is free)."""
+        if self._pending is not None:
+            # a contained fetch fault re-pended the step's fetches for
+            # retry: dispatching over them would clobber the healthy
+            # slots' already-computed tokens and fork their streams
+            return
         mask = self._active_mask()
         if not mask.any():
             return
-        _F_DECODE.hit()
         dec = self._progs["decode"]
         t0 = time.perf_counter()
-        with scope_guard(self.scope), _monitor.span("serve.decode"):
-            fetches = self._exe.run(
-                self._progs["decode_program"],
-                feed={dec["feeds"][0].name: mask},
-                fetch_list=[dec["emit"], dec["live"], dec["pos"]],
-                async_fetch=True)
+        try:
+            with scope_guard(self.scope), _monitor.span("serve.decode"), \
+                    _monitor.stall_guard("serve.decode",
+                                         self.stall_deadline_ms or None):
+                _F_DECODE.hit()
+                fetches = self._exe.run(
+                    self._progs["decode_program"],
+                    feed={dec["feeds"][0].name: mask},
+                    fetch_list=[dec["emit"], dec["live"], dec["pos"],
+                                dec["maxabs"]],
+                    async_fetch=True)
+        except Exception as e:
+            self._contain_decode_error(e)
+            return
         snapshot = [s.request if m else None
                     for s, m in zip(self._slots, mask)]
-        self._pending = (fetches, snapshot, t0)
+        self._pending = (fetches, snapshot, t0, False)
         self.decode_steps += 1
         _M_DECODE_STEPS.inc()
 
+    def _attribute_or_fail(self, exc) -> List[int]:
+        """Shared decode/fetch failure classification: RESOURCE_EXHAUSTED
+        runs the OOM forensics hook (phase="serve"; the executor already
+        ran donated-buffer hygiene) and fails the engine; an error with
+        no slot hint is unattributable and fails the engine; otherwise
+        the candidate slot list is returned for the caller's eviction
+        body. One policy, two call sites — they must not diverge."""
+        if _monitor.is_oom_error(exc):
+            _monitor.maybe_record_oom(
+                exc, program=self._progs["decode_program"], phase="serve")
+            self._fail(exc)
+            raise exc
+        hints = _slot_hints(exc)
+        if hints is None:
+            self._fail(exc)
+            raise exc
+        return hints
+
+    def _contain_decode_error(self, exc):
+        """Dispatch-path failure policy: a slot-hinted error evicts only
+        the poisoned slots (the fault fired before/at dispatch — device
+        state for the healthy slots is consistent, no token was lost);
+        anything unattributable fails the engine."""
+        hints = self._attribute_or_fail(exc)
+        evicted = []
+        with self._lock:
+            for i in hints:
+                if 0 <= i < self.slots:
+                    req = self._slots[i].request
+                    if req is not None and req.outcome is None:
+                        self._finish_slot(i, req, "evicted")
+                        _M_SLOT_EVICTIONS.inc(labels={"cause": "fault"})
+                        evicted.append(i)
+            _publish_gauges()
+        if not evicted:
+            # the hint named no active slot (out of range, or already
+            # finished): nothing was contained — swallowing it would
+            # livelock a persistently failing decode step
+            self._fail(exc)
+            raise exc
+        self._scrub_evicted(evicted)
+
+    def _contain_fetch_error(self, exc, fetches, snapshot, t0,
+                             retried) -> List[int]:
+        """Materialization-path failure policy (caller holds the lock):
+        a slot-hinted error evicts the poisoned slots and re-pends the
+        step's fetches for ONE retry (the healthy slots' tokens are
+        still in the buffers — dropping them would fork their streams);
+        a second failure or an unattributable one fails the engine.
+        Returns the evicted slots for the caller to scrub OUTSIDE the
+        lock (the scrub is a blocking device call)."""
+        hints = self._attribute_or_fail(exc)
+        if retried:
+            self._fail(exc)
+            raise exc
+        evicted = []
+        for i in hints:
+            if 0 <= i < self.slots:
+                req = self._slots[i].request
+                if (req is not None and req.outcome is None
+                        and snapshot[i] is req):
+                    self._finish_slot(i, req, "evicted")
+                    _M_SLOT_EVICTIONS.inc(labels={"cause": "fault"})
+                    snapshot[i] = None
+                    evicted.append(i)
+        if not evicted:
+            # hint matched no active slot: nothing was contained (see
+            # _contain_decode_error — a swallow here would livelock)
+            self._fail(exc)
+            raise exc
+        self._pending = (fetches, snapshot, t0, True)
+        _publish_gauges()
+        return evicted
+
+    def _scrub_evicted(self, slots: List[int]):
+        """Run the per-slot device scrub AFTER the engine lock is
+        released — a blocking device call under the lock would wedge
+        submit()/busy()/the supervisor watchdog (the exact hang the
+        watchdog exists to recover from). Safe lock-free: only the one
+        driver thread admits, so a freed slot cannot be re-occupied
+        before its scrub runs. A FAILING scrub fails the engine: an
+        unscrubbed slot would re-poison its next occupant."""
+        for i in slots:
+            try:
+                self._scrub_slot_state(i)
+            except Exception as e:
+                self._fail(e)
+                raise
+
+    def _fail(self, exc):
+        """Mark the engine failed (unattributable decode/fetch fault:
+        device state untrusted). Pending handles stay pending — an
+        EngineSupervisor harvests and replays them; an unsupervised
+        caller's close() finishes them with outcome 'error'."""
+        if self._failed:
+            return
+        self._failed = True
+        self.last_error = f"{type(exc).__name__}: {exc}"[:500]
+        _note_engine_state(self.engine_id, "failed")
+        _publish_gauges()
+
+    def _scrub_slot_state(self, i: int):
+        """Zero slot ``i``'s row in every device-resident serving
+        tensor. A poisoned occupant's non-finite K/V rows would
+        re-poison the NEXT occupant straight through the softmax mask
+        (a masked weight underflows to exactly 0.0, and 0 * NaN = NaN),
+        so eviction must scrub, not just free, the slot. Runs the
+        compiled slot-scrub program (transformer.build_slot_scrub) so
+        the caches stay on device — a host round-trip of the full KV
+        rings to zero one row would stall every healthy slot."""
+        scr = self._progs["scrub"]
+        with scope_guard(self.scope):
+            self._exe.run(
+                self._progs["scrub_program"],
+                feed={scr["feeds"][0].name: np.asarray([i], np.int64)},
+                fetch_list=[])
+
     def _process_ready(self) -> int:
         """Materialize the pending decode step's fetches and hand each
-        slot's token to its request; evict finished/expired requests
-        (their slots free for the next admission round)."""
-        if self._pending is None:
+        slot's token to its request; evict finished/expired/poisoned
+        requests (their slots free for the next admission round).
+
+        The blocking device wait runs OUTSIDE the engine lock: a hung
+        fetch must not wedge submit()/busy()/the supervisor watchdog
+        behind it (the lock is taken only to swap the pending step out
+        and to apply its results)."""
+        with self._lock:
+            if self._failed or self._closed:
+                self._pending = None
+                return 0
+            if self._pending is None:
+                return 0
+            fetches, snapshot, t0, retried = self._pending
+            self._pending = None
+        try:
+            _F_FETCH.hit()
+            emit, live, pos, maxabs = [np.asarray(a) for a in fetches]
+        except Exception as e:
+            with self._lock:
+                if self._failed or self._closed:
+                    return 0
+                to_scrub = self._contain_fetch_error(
+                    e, fetches, snapshot, t0, retried)
+            self._scrub_evicted(to_scrub)  # device call: outside lock
             return 0
-        fetches, snapshot, t0 = self._pending
-        self._pending = None
-        emit, live, pos = [np.asarray(a) for a in fetches]
-        now = time.perf_counter()
-        step_s = now - t0
-        emitted = 0
-        for i, req in enumerate(snapshot):
-            if req is None or req.outcome is not None:
-                continue
-            tok = int(emit[i])
-            alive = bool(live[i])
-            if not alive and tok == self.end_id:
-                # EOS (or a dead-slot freeze): terminal, token dropped
-                self._finish_slot(i, req, "completed")
-                continue
-            req.tokens.append(tok)
-            emitted += 1
-            self.tokens_emitted += 1
-            _M_TOKENS.inc()
-            _M_TOKEN_SECONDS.observe(step_s)
-            if req.ttft_s is None:
-                req.ttft_s = now - req.submit_ts
-                _M_TTFT_SECONDS.observe(req.ttft_s)
-            if not alive or len(req.tokens) >= req.max_new_tokens:
-                # device length cap (max_len positions) or the request's
-                # own token budget: terminal without an EOS
-                self._finish_slot(i, req, "length")
-            elif (req.deadline_ts is not None and now > req.deadline_ts):
-                # deadline eviction AT the token boundary: the slot is
-                # freed now; the partial output stays on the handle
-                self._finish_slot(i, req, "expired")
-        _publish_gauges()
+        with self._lock:
+            if self._failed or self._closed:
+                # harvested/closed while we were waiting: the snapshot's
+                # requests may already be replaying elsewhere — discard
+                return 0
+            now = time.perf_counter()
+            step_s = now - t0
+            # measured per-token latency (admission-control estimator).
+            # The engine's FIRST decode step carries the XLA compile (or
+            # the disk-cache load) — 10-100x a steady-state step — so it
+            # never seeds the EWMA: a compile-poisoned estimate would
+            # make every deadline look meetable for dozens of steps.
+            if not self._ewma_skipped_first:
+                self._ewma_skipped_first = True
+            else:
+                self._step_walls.append(step_s)
+                if self._token_ewma_s is None:
+                    self._token_ewma_s = step_s
+                else:
+                    self._token_ewma_s = (0.8 * self._token_ewma_s
+                                          + 0.2 * step_s)
+            emitted = 0
+            to_scrub = []
+            for i, req in enumerate(snapshot):
+                if req is None or req.outcome is not None:
+                    continue
+                if not np.isfinite(maxabs[i]):
+                    # poisoned slot: non-finite logits. Contained — the
+                    # request keeps its partial output, the slot is
+                    # scrubbed (below, outside the lock) + freed,
+                    # healthy slots keep decoding. Reported through the
+                    # numerics plane (counter + provenance record).
+                    _numerics.note_nonfinite(
+                        "decode_step", f"slot{i}:logits",
+                        program_uid=self._progs["decode_program"]._uid,
+                        step=self.decode_steps, kind="serve",
+                        maxabs=float(maxabs[i]))
+                    self._finish_slot(i, req, "error")
+                    to_scrub.append(i)
+                    _M_SLOT_EVICTIONS.inc(labels={"cause": "nonfinite"})
+                    continue
+                tok = int(emit[i])
+                alive = bool(live[i])
+                if not alive and tok == self.end_id:
+                    # EOS (or a dead-slot freeze): terminal, token dropped
+                    self._finish_slot(i, req, "completed")
+                    continue
+                req.tokens.append(tok)
+                emitted += 1
+                self.tokens_emitted += 1
+                _M_TOKENS.inc()
+                _M_TOKEN_SECONDS.observe(step_s)
+                if req.ttft_s is None:
+                    req.ttft_s = now - req.submit_ts
+                    _M_TTFT_SECONDS.observe(req.ttft_s)
+                if not alive or len(req.tokens) >= req.max_new_tokens:
+                    # device length cap (max_len positions) or the
+                    # request's own token budget: terminal without EOS
+                    self._finish_slot(i, req, "length")
+                elif (req.deadline_ts is not None
+                        and now > req.deadline_ts):
+                    # deadline eviction AT the token boundary: the slot
+                    # is freed now; the partial output stays on the
+                    # handle (also the path a deadline expiring while
+                    # the async fetch was in flight resolves through)
+                    self._finish_slot(i, req, "expired")
+            _publish_gauges()
+        # the scrubs run with the lock RELEASED and the whole token loop
+        # already applied: a scrub failure cannot drop a healthy slot's
+        # materialized token, and a hung scrub stays watchdog-visible
+        self._scrub_evicted(to_scrub)
         return emitted
 
     def _finish_slot(self, i: int, req: ServeRequest, outcome: str):
@@ -547,9 +1047,50 @@ class ServingEngine:
         self.completed += 1
         self._slots[i].request = None
 
+    def _harvest_for_replay(self) -> List[ServeRequest]:
+        """Supervisor-only: atomically mark the engine failed and take
+        every pending (outcome-less) request — in-flight first (their
+        admission order), then the queue — so close() cannot finish
+        them and the restarted engine can replay them."""
+        with self._lock:
+            self._failed = True
+            if self.last_error is None:
+                self.last_error = "harvested for supervised restart"
+            out = []
+            for s in self._slots:
+                req, s.request = s.request, None
+                if req is not None and req.outcome is None:
+                    out.append(req)
+            while self._queue:
+                r = self._queue.popleft()
+                if r.outcome is None:
+                    out.append(r)
+            self._pending = None
+            _publish_gauges()
+        _note_engine_state(self.engine_id, "failed")
+        return out
+
+    def _enqueue_replay(self, req: ServeRequest):
+        """Supervisor replay intake: bypasses backpressure + admission
+        control (the requests were already admitted once — refusing a
+        replay would turn one engine fault into request failures). The
+        partial output survives until the replay actually re-prefills;
+        a dead intake finishes the handle 'error' with it intact."""
+        with self._lock:
+            if self._closed or self._failed:
+                req._finish("error")
+                return
+            req._replay_pending = True
+            if (not self._queue and self._pending is None
+                    and all(s.request is None for s in self._slots)):
+                self._beat = time.perf_counter()  # idle-only, as submit
+            self._queue.append(req)
+            _publish_gauges()
+
     @property
     def state(self) -> str:
         return ("closed" if self._closed
+                else "failed" if self._failed
                 else "draining" if self._draining else "serving")
 
     def stats(self) -> Dict:
@@ -569,9 +1110,309 @@ class ServingEngine:
             "tokens_emitted": self.tokens_emitted,
             "requests_completed": self.completed,
             "draining": self._draining,
+            "brownout": self.brownout,
+            "last_error": self.last_error,
+            "token_ewma_ms": (None if self._token_ewma_s is None
+                              else round(self._token_ewma_s * 1e3, 3)),
+            "step_wall_ms_p99": (
+                None if not self._step_walls
+                else round(float(np.percentile(
+                    list(self._step_walls), 99)) * 1e3, 3)),
             "int8": self.int8,
             "pipeline_depth": self.pipeline_depth,
         }
+
+
+class EngineSupervisor:
+    """Self-healing serving process: owns a ServingEngine, the thread
+    that drives its decode loop, and a watchdog that warm-restarts it.
+
+    Failure handling:
+
+    - **crashed**: an engine-fatal error (unattributable decode/fetch
+      fault, device OOM) escapes ``step()`` on the loop thread;
+    - **wedged**: the engine is busy but its decode heartbeat is older
+      than ``serve_wedge_timeout_ms`` (e.g. a hung device call) — the
+      watchdog declares it dead without waiting for it to return, and
+      emits the stall record a ``monitor.stall_guard`` would have
+      produced (site ``serve.decode``; a per-dispatch guard would cost
+      one Timer thread per decode step). Wedge
+      detection arms only after the engine's FIRST decode step
+      completes: a first-step XLA compile legitimately holds the
+      heartbeat for 10-100x a steady-state step and must not read as a
+      wedge (set ``compile_cache_dir`` so rebuilds skip even that).
+
+    Either way the old engine is harvested (every queued + in-flight
+    handle taken before close() can finish it), torn down, and a new
+    engine is built — through the persistent compile cache when
+    ``compile_cache_dir`` is set, i.e. zero fresh XLA compiles — under
+    a retry.py policy; the harvested requests are re-prefilled in their
+    original order and decode from scratch (greedy is deterministic:
+    byte-identical tokens). The restart budget (``serve_max_restarts``)
+    bounds a permanently failing engine: past it, pending handles
+    finish with outcome 'error' and the supervisor closes.
+
+    Metered: ``pt_serve_engine_restarts_total``,
+    ``pt_serve_requests_replayed_total``.
+    """
+
+    def __init__(self, cfg, weights, *,
+                 wedge_timeout_ms: Optional[float] = None,
+                 max_restarts: Optional[int] = None,
+                 restart_policy: Optional["_retry.RetryPolicy"] = None,
+                 restart_deadline_s: float = 60.0,
+                 poll_s: float = 0.02, **engine_kwargs):
+        self._cfg = cfg
+        self._weights = weights
+        self._engine_kwargs = dict(engine_kwargs)
+        self.wedge_timeout_s = (
+            float(_flags.get_flag("serve_wedge_timeout_ms"))
+            if wedge_timeout_ms is None else float(wedge_timeout_ms)) / 1e3
+        self.max_restarts = (int(_flags.get_flag("serve_max_restarts"))
+                             if max_restarts is None else int(max_restarts))
+        self._restart_policy = restart_policy or _retry.RetryPolicy(
+            base_delay=0.05, max_delay=2.0, max_attempts=3,
+            retry_on=(Exception,))
+        self._restart_deadline_s = float(restart_deadline_s)
+        self._poll_s = float(poll_s)
+        self.restarts = 0
+        self.replayed = 0
+        self._lock = threading.RLock()
+        self._closed = False
+        self._gen = 0
+        self._work = threading.Event()
+        self._engine = self._build()
+        self._loop_thread = self._start_loop(self._gen, self._engine)
+        self._watch_thread = threading.Thread(
+            target=self._watch, name="pt-serve-watchdog", daemon=True)
+        self._watch_thread.start()
+
+    def _build(self) -> ServingEngine:
+        # NOTE: the supervisor does NOT arm a per-dispatch stall_guard —
+        # a threading.Timer per few-ms decode step is real thread churn
+        # on the hot path. The watchdog emits the equivalent stall
+        # record itself when it declares a wedge (same site, same
+        # deadline); engines still honor the global stall_timeout_ms
+        # flag like every other guarded plane.
+        return ServingEngine(self._cfg, self._weights,
+                             **self._engine_kwargs)
+
+    def _start_loop(self, gen: int, eng: ServingEngine):
+        t = threading.Thread(target=self._serve_loop, args=(gen, eng),
+                             name=f"pt-serve-loop-{eng.engine_id}",
+                             daemon=True)
+        t.start()
+        return t
+
+    # --- public surface ---
+
+    @property
+    def engine(self) -> ServingEngine:
+        with self._lock:
+            return self._engine
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return "closed" if self._closed else self._engine.state
+
+    def submit(self, *args, **kwargs) -> ServeRequest:
+        """Enqueue onto the CURRENT engine; a submit racing a restart
+        retries onto the rebuilt one. QueueFull / DeadlineUnmeetable
+        propagate (overload is the caller's signal, not the
+        supervisor's problem)."""
+        deadline = time.monotonic() + max(10.0, self._restart_deadline_s)
+        while True:
+            with self._lock:
+                if self._closed:
+                    raise EngineClosed("submit() on a closed supervisor")
+                eng = self._engine
+            try:
+                req = eng.submit(*args, **kwargs)
+            except (EngineFailed, EngineClosed):
+                with self._lock:
+                    if self._closed:
+                        raise
+                    current = self._engine
+                if current is eng and not eng._failed:
+                    # the engine is draining/closed by an EXPLICIT
+                    # drain, not mid-replacement: fail fast instead of
+                    # spinning the retry window
+                    raise
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(self._poll_s)
+                continue
+            self._work.set()
+            return req
+
+    def busy(self) -> bool:
+        return self.engine.busy()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop admissions and wait for the loop thread to finish the
+        in-flight set (re-applied to the rebuilt engine if a restart
+        lands mid-drain)."""
+        t0 = time.perf_counter()
+        while True:
+            eng = self.engine
+            eng.request_drain()
+            self._work.set()
+            if not eng.busy() and eng is self.engine:
+                return True
+            if time.perf_counter() - t0 > timeout_s:
+                return False
+            time.sleep(self._poll_s)
+
+    def close(self, drain_timeout_s: float = 30.0):
+        """Drain, stop the loop + watchdog threads, close the engine.
+        Every still-pending handle is finished — result() never hangs
+        on a closed supervisor."""
+        with self._lock:
+            if self._closed:
+                return
+        self.drain(drain_timeout_s)
+        with self._lock:
+            self._closed = True
+            self._gen += 1  # stops the loop thread at its next check
+            eng = self._engine
+        self._work.set()
+        for t in (self._loop_thread, self._watch_thread):
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+        eng.close(drain_timeout_s=0.0)
+
+    def stats(self) -> Dict:
+        eng = self.engine
+        return {
+            "supervised": True,
+            "state": self.state,
+            "restarts": self.restarts,
+            "max_restarts": self.max_restarts,
+            # intakes, not admissions: pt_serve_requests_replayed_total
+            # is the re-prefill count and can lag this by replays that
+            # died (drained/errored) before reaching prefill
+            "replays_enqueued": self.replayed,
+            "wedge_timeout_ms": self.wedge_timeout_s * 1e3,
+            "engine": eng.stats(),
+        }
+
+    # --- the supervised loop + watchdog ---
+
+    def _serve_loop(self, gen: int, eng: ServingEngine):
+        while True:
+            with self._lock:
+                if self._closed or gen != self._gen:
+                    return
+            try:
+                if eng.busy():
+                    eng.step()
+                else:
+                    self._work.wait(self._poll_s)
+                    self._work.clear()
+            except EngineClosed:
+                return
+            except Exception as e:
+                if eng._failed:
+                    self._on_engine_failure(gen, eng, e)
+                    return
+                # non-fatal (e.g. a torn admission already surfaced on
+                # its handle): the engine is healthy, keep serving
+                warnings.warn(
+                    f"supervised engine {eng.engine_id}: non-fatal "
+                    f"serving error: {type(e).__name__}: {e}",
+                    RuntimeWarning)
+
+    def _watch(self):
+        while True:
+            time.sleep(self._poll_s)
+            with self._lock:
+                if self._closed:
+                    return
+                eng, gen = self._engine, self._gen
+            # decode_steps > 0: wedge detection only on a WARMED engine
+            # (a first-step compile holds the heartbeat legitimately)
+            if (not eng._failed and not eng._closed
+                    and eng.decode_steps > 0 and eng.busy()
+                    and eng.heartbeat_age_s() > self.wedge_timeout_s):
+                if _monitor.enabled():
+                    # the stall record a per-dispatch stall_guard would
+                    # have produced, emitted once at declaration (the
+                    # monitor helper is same-package and never raises)
+                    _monitor._record_stall(
+                        "serve.decode", self.wedge_timeout_s * 1e3,
+                        self._loop_thread.name, ())
+                with self._lock:
+                    if self._closed or gen != self._gen:
+                        continue
+                    self._restart_locked(
+                        eng, reason=f"wedged (heartbeat "
+                        f"{eng.heartbeat_age_s() * 1e3:.0f} ms old)")
+
+    def _on_engine_failure(self, gen: int, eng: ServingEngine, exc):
+        with self._lock:
+            if self._closed or gen != self._gen:
+                return
+            self._restart_locked(
+                eng, reason=f"{type(exc).__name__}: {exc}")
+
+    def _restart_locked(self, old: ServingEngine, reason: str):
+        """Tear down + rebuild + replay. Caller holds self._lock."""
+        pending = old._harvest_for_replay()
+        if self.restarts >= self.max_restarts:
+            warnings.warn(
+                f"serving supervisor: restart budget "
+                f"({self.max_restarts}) exhausted ({reason}); failing "
+                f"{len(pending)} pending request(s)", RuntimeWarning)
+            for r in pending:
+                r._finish("error")
+            self._closed = True
+            self._gen += 1
+            try:
+                old.close(drain_timeout_s=0.0)
+            except Exception:
+                pass
+            return
+        self.restarts += 1
+        _M_RESTARTS.inc()
+        warnings.warn(
+            f"serving supervisor: restarting engine {old.engine_id} "
+            f"({reason}); replaying {len(pending)} request(s)",
+            RuntimeWarning)
+        try:
+            old.close(drain_timeout_s=0.0)
+        except Exception:
+            pass
+        try:
+            # warm rebuild under the retry budget: with
+            # compile_cache_dir set every executable resolves from disk
+            # (zero fresh compiles — the warm-replica path)
+            new = _retry.call(self._build, site="serve.restart",
+                              policy=self._restart_policy,
+                              retry_on=(Exception,),
+                              deadline_s=self._restart_deadline_s)
+        except Exception as e:
+            warnings.warn(
+                f"serving supervisor: engine rebuild failed after "
+                f"retries ({type(e).__name__}: {e}); failing "
+                f"{len(pending)} pending request(s)", RuntimeWarning)
+            for r in pending:
+                r._finish("error")
+            self._closed = True
+            self._gen += 1
+            return
+        self._gen += 1
+        self._engine = new
+        for r in pending:
+            # self.replayed counts replay INTAKES; the token wipe and
+            # the pt_serve_requests_replayed_total tick happen at the
+            # new engine's ADMISSION (_reset_for_replay), so a replay
+            # that never reaches prefill keeps its partial output and
+            # the metric counts only true re-prefills
+            self.replayed += 1
+            new._enqueue_replay(r)
+        self._work.set()
+        self._loop_thread = self._start_loop(self._gen, new)
 
 
 def _is_tpu_default() -> bool:
@@ -587,22 +1428,26 @@ _ENGINES: "weakref.WeakSet[ServingEngine]" = weakref.WeakSet()
 
 
 def _publish_gauges():
-    """Refresh the process-wide queue/slot gauges as SUMS across live
-    engines — per-engine .set() calls would let an idle engine zero out
-    a saturated neighbor's reading (the per-engine split lives in
-    /serve's stats rows)."""
+    """Refresh the process-wide queue/slot/brownout gauges as SUMS
+    across live engines — per-engine .set() calls would let an idle
+    engine zero out a saturated neighbor's reading (the per-engine
+    split lives in /serve's stats rows)."""
     engines = list(_ENGINES)
     _M_QUEUE_DEPTH.set(sum(len(e._queue) for e in engines))
     _M_SLOTS_ACTIVE.set(sum(
         1 for e in engines for s in e._slots
         if s.request is not None and s.request.outcome is None))
+    _M_BROWNOUT.set(sum(1 for e in engines if e.brownout))
 
 
-def serve(cfg, weights, **kwargs) -> ServingEngine:
+def serve(cfg, weights, *, supervised: bool = False, **kwargs):
     """Predictor-style front end: build a ServingEngine over ``weights``
     (a Scope, a Predictor, or a saved inference-model directory — the
-    int8 PTQ artifact deploys dequantized). See ServingEngine for the
-    geometry/SLO knobs."""
+    int8 PTQ artifact deploys dequantized). ``supervised=True`` wraps
+    it in an EngineSupervisor (self-driving decode loop + watchdog +
+    warm restart). See ServingEngine for the geometry/SLO knobs."""
+    if supervised:
+        return EngineSupervisor(cfg, weights, **kwargs)
     return ServingEngine(cfg, weights, **kwargs)
 
 
@@ -614,6 +1459,8 @@ def summary() -> Dict:
         "engine_count": len(engines),
         "tokens_total": int(_M_TOKENS.value()),
         "decode_steps_total": int(_M_DECODE_STEPS.value()),
+        "engine_restarts_total": int(_M_RESTARTS.value()),
+        "requests_replayed_total": int(_M_REPLAYED.value()),
         "token_latency_s": {
             label: _M_TOKEN_SECONDS.quantile(q)
             for label, q in _monitor.QUANTILE_LABELS

@@ -208,3 +208,37 @@ def test_committed_history_flags_synthetic_twenty_percent_drop(
     out = json.loads(capsys.readouterr().out)
     assert any(f["metric"] == "transformer_base_train_tokens_per_sec"
                for f in out["regressions"])
+
+
+def test_degraded_serving_family_gates_with_wide_tolerance():
+    """The bench_serving degraded-mode rider (tokens/s under a seeded
+    serve.decode delay fault at 1% of steps) is a gated family: a 30%
+    drop is flagged under its 0.20 tolerance, a 15% drop is inside it —
+    resilience overhead is tracked, not guessed."""
+    assert bench_regress.FAMILY_TOLERANCE[
+        "serving_degraded_tokens_per_sec"] == pytest.approx(0.20)
+    base = _row(
+        5000.0, metric="serving_decode_tokens_per_sec",
+        degraded={"metric": "serving_degraded_tokens_per_sec",
+                  "value": 4000.0, "unit": "tokens/sec",
+                  "token_ms_p99": 2.0})
+    flat = bench_regress.flatten_row(base)
+    assert flat["serving_degraded_tokens_per_sec"]["value"] == 4000.0
+    history = [("r06", flat)]
+
+    def fresh(v):
+        return bench_regress.flatten_row(_row(
+            5000.0, metric="serving_decode_tokens_per_sec",
+            degraded={"metric": "serving_degraded_tokens_per_sec",
+                      "value": v, "unit": "tokens/sec"}))
+
+    (f,) = bench_regress.check(fresh(2800.0), history)  # -30%
+    assert f["metric"] == "serving_degraded_tokens_per_sec"
+    assert f["tolerance"] == pytest.approx(0.20)
+    assert bench_regress.check(fresh(3400.0), history) == []  # -15%
+    # a crashed degraded sweep (row absent) is itself a finding
+    missing = bench_regress.flatten_row(_row(
+        5000.0, metric="serving_decode_tokens_per_sec"))
+    (f,) = bench_regress.check(missing, history)
+    assert f["metric"] == "serving_degraded_tokens_per_sec"
+    assert f.get("missing") is True

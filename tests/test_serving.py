@@ -317,7 +317,8 @@ def test_queue_and_slot_gauges_sum_across_engines(weights):
 
 def test_serve_fault_sites_registered_and_fire(weights):
     cfg, scope = weights
-    assert {"serve.enqueue", "serve.decode"} <= set(faults.BUILTIN_SITES)
+    assert {"serve.enqueue", "serve.prefill", "serve.decode",
+            "serve.fetch"} <= set(faults.BUILTIN_SITES)
     eng = serving.ServingEngine(cfg, scope, slots=1, src_len=8, max_len=8)
     faults.arm("serve.enqueue:raise@1")
     try:
@@ -325,7 +326,29 @@ def test_serve_fault_sites_registered_and_fire(weights):
             eng.submit([2, 3, 4])
     finally:
         faults.disarm()
-    # decode-site fault fires BEFORE dispatch: the engine keeps serving
+    # a prefill-site fault tears the admission seam: the popped request
+    # surfaces 'error' on its handle, the engine keeps serving
+    req = eng.submit([2, 3, 4])
+    faults.arm("serve.prefill:raise@1")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            eng.run_until_idle()
+    finally:
+        faults.disarm()
+    assert req.done and req.outcome == "error"
+    req2 = eng.submit([2, 3, 4])
+    eng.run_until_idle()
+    assert req2.done and req2.outcome in ("completed", "length")
+    eng.close()
+
+
+def test_unhinted_decode_fault_fails_engine(weights):
+    """A decode raise WITHOUT a slot hint is an unattributable device
+    error: the engine fails (an EngineSupervisor would restart it),
+    step() raises EngineFailed from then on, and close() finishes the
+    pending handle with 'error' — result() never hangs."""
+    cfg, scope = weights
+    eng = serving.ServingEngine(cfg, scope, slots=1, src_len=8, max_len=8)
     req = eng.submit([2, 3, 4])
     faults.arm("serve.decode:raise@1")
     try:
@@ -333,9 +356,15 @@ def test_serve_fault_sites_registered_and_fire(weights):
             eng.run_until_idle()
     finally:
         faults.disarm()
-    eng.run_until_idle()
-    assert req.done and req.outcome in ("completed", "length")
+    assert eng.state == "failed"
+    with pytest.raises(serving.EngineFailed):
+        eng.step()
+    with pytest.raises(serving.EngineFailed):
+        eng.submit([5, 6])
+    assert not req.done  # pending: a supervisor could still replay it
     eng.close()
+    assert req.done and req.outcome == "error"
+    assert req.result(timeout=1) == []
 
 
 def test_serve_metrics_and_route(weights):
