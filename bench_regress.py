@@ -6,11 +6,15 @@ exits nonzero when any family's throughput regressed: a metric fails
 when its ``value_mean`` (falling back to ``value``) drops more than the
 family tolerance below the TRAILING BEST across the history rounds.
 
-Only higher-is-better throughput metrics are gated — rows whose
-``unit`` contains ``/sec`` (tokens/sec, images/sec, examples/sec).
-Lower-is-better riders (warm-start seconds, pipeline step times) are
-reported informationally but never gate: a "best" for them would be
-inverted, and their CPU-vs-TPU variance is not a regression signal.
+Two metric classes are gated. Higher-is-better throughput metrics —
+rows whose ``unit`` contains ``/sec`` (tokens/sec, images/sec,
+examples/sec) — fail when they drop below the trailing best. A small
+explicit allowlist of lower-is-better latency metrics
+(``LATENCY_TOLERANCE``: serving TTFT / queue-wait p95) fail when they
+rise above the trailing best (the MINIMUM across history). All other
+lower-is-better riders (warm-start seconds, pipeline step times) are
+reported informationally but never gate: their CPU-vs-TPU variance is
+not a regression signal.
 
 Usage:
     python bench_regress.py                  # newest BENCH_r*.json vs
@@ -49,6 +53,18 @@ FAMILY_TOLERANCE: Dict[str, float] = {
     # resilience overhead; the injected delays add sampling noise on
     # top of the host jitter, so it gets the widest envelope
     "serving_degraded_tokens_per_sec": 0.20,
+}
+
+# Lower-is-better latency families (explicit allowlist — a unit of
+# "ms" alone does NOT gate): fraction ABOVE the trailing best (the
+# minimum across history) that still passes. The serving latency
+# riders are host-timed tail percentiles over a small request sample,
+# so they carry far more noise than the throughput means — hence the
+# wide 50% envelope; tighten per-family once the committed history
+# shows a stable floor.
+LATENCY_TOLERANCE: Dict[str, float] = {
+    "serving_ttft_ms_p95": 0.50,
+    "serving_queue_wait_ms_p95": 0.50,
 }
 
 # Deliberately dropped families: a gated metric carried by ANY history
@@ -112,6 +128,11 @@ def gated(unit: str) -> bool:
     return "/sec" in unit
 
 
+def gated_latency(metric: str) -> bool:
+    """Whether a metric is on the lower-is-better latency allowlist."""
+    return metric in LATENCY_TOLERANCE
+
+
 def check(fresh: Dict[str, Dict[str, Any]],
           history: List[Tuple[str, Dict[str, Any]]],
           tolerance: float = DEFAULT_TOLERANCE) -> List[Dict[str, Any]]:
@@ -130,7 +151,7 @@ def check(fresh: Dict[str, Dict[str, Any]],
     carriers: Dict[str, Tuple[str, Dict[str, Any]]] = {}
     for rname, flat in history:
         for metric, cell in flat.items():
-            if gated(cell.get("unit", "")):
+            if gated(cell.get("unit", "")) or gated_latency(metric):
                 carriers[metric] = (rname, cell)
     for metric, (rname, cell) in sorted(carriers.items()):
         if metric not in fresh and metric not in RETIRED_METRICS:
@@ -141,7 +162,8 @@ def check(fresh: Dict[str, Dict[str, Any]],
                 "best": cell["value"],
                 "best_round": rname,
                 "ratio": 0.0,
-                "tolerance": FAMILY_TOLERANCE.get(metric, tolerance),
+                "tolerance": LATENCY_TOLERANCE.get(
+                    metric, FAMILY_TOLERANCE.get(metric, tolerance)),
                 "missing": True,
             })
     for metric, cell in sorted(fresh.items()):
@@ -167,6 +189,33 @@ def check(fresh: Dict[str, Dict[str, Any]],
                 "best_round": best_round,
                 "ratio": round(ratio, 4),
                 "tolerance": tol,
+            })
+    # lower-is-better latency allowlist: "best" is the MINIMUM across
+    # history; a fresh value more than 1+tol times the best fails
+    for metric, cell in sorted(fresh.items()):
+        if not gated_latency(metric):
+            continue
+        best = best_round = None
+        for rname, flat in history:
+            prev = flat.get(metric)
+            if prev is None:
+                continue
+            if best is None or prev["value"] < best:
+                best, best_round = prev["value"], rname
+        if best is None or best <= 0:
+            continue
+        tol = LATENCY_TOLERANCE[metric]
+        ratio = cell["value"] / best
+        if ratio > 1.0 + tol:
+            findings.append({
+                "metric": metric,
+                "value": cell["value"],
+                "unit": cell["unit"],
+                "best": best,
+                "best_round": best_round,
+                "ratio": round(ratio, 4),
+                "tolerance": tol,
+                "direction": "above",
             })
     return findings
 
@@ -216,7 +265,8 @@ def main(argv=None) -> int:
         "row": fresh_name,
         "rounds": [name for name, _ in history],
         "gated_metrics": sorted(m for m, c in fresh.items()
-                                if gated(c.get("unit", ""))),
+                                if gated(c.get("unit", ""))
+                                or gated_latency(m)),
         "regressions": findings,
         "ok": not findings,
     }
@@ -228,6 +278,12 @@ def main(argv=None) -> int:
                       f"fresh row (was {f['best']:.1f} {f['unit']} in "
                       f"{f['best_round']}) — did the family's bench "
                       f"subprocess crash?", file=sys.stderr)
+            elif f.get("direction") == "above":
+                print(f"REGRESSION {f['metric']}: {f['value']:.1f} "
+                      f"{f['unit']} is {f['ratio']:.1%} of the "
+                      f"trailing best (lowest) {f['best']:.1f} "
+                      f"({f['best_round']}; tolerance "
+                      f"+{f['tolerance']:.0%})", file=sys.stderr)
             else:
                 print(f"REGRESSION {f['metric']}: {f['value']:.1f} "
                       f"{f['unit']} is {f['ratio']:.1%} of the "

@@ -23,6 +23,13 @@ fault-injection overhead is tracked by bench_regress.py
 (``serving_degraded_tokens_per_sec``), so resilience cost is measured,
 not guessed.
 
+Every sweep row also carries TTFT and queue-wait p50/p95 (from the
+request plane's per-request phase decomposition), and the headline
+emits ``serving_ttft_ms_p95`` / ``serving_queue_wait_ms_p95`` as
+lower-is-better latency riders that bench_regress.py gates under
+``LATENCY_TOLERANCE`` — a latency family carried by history but
+missing from a fresh row is itself a finding.
+
 Env knobs: ``PT_BENCH_CPU=1`` forces the CPU backend;
 ``PT_BENCH_SERVE_SIZE=tiny|base`` picks the model (tiny for CPU smokes);
 ``PT_BENCH_SERVE_SLOTS`` (default 8), ``PT_BENCH_SERVE_SRC`` source
@@ -109,9 +116,14 @@ def _sweep_level(cfg, scope, concurrency, n_requests, monitor):
     fresh = monitor.counter(
         "pt_executor_cache_misses_total").value() - misses0
     ttft = [r.ttft_s for r in inflight if r.ttft_s is not None]
+    qwait = [r.queue_wait_s for r in inflight if r.queue_wait_s is not None]
     done = sum(1 for r in inflight if r.outcome in ("completed", "length"))
     eng.close()
     lat = np.asarray(token_lat) if token_lat else np.asarray([0.0])
+
+    def _pct(xs, q):
+        return round(float(np.percentile(xs, q)) * 1e3, 3) if xs else None
+
     return {
         "concurrency": concurrency,
         "requests": done,
@@ -120,8 +132,10 @@ def _sweep_level(cfg, scope, concurrency, n_requests, monitor):
         "token_ms_p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
         "token_ms_p95": round(float(np.percentile(lat, 95)) * 1e3, 3),
         "token_ms_p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
-        "ttft_ms_p50": round(float(np.percentile(ttft, 50)) * 1e3, 3)
-        if ttft else None,
+        "ttft_ms_p50": _pct(ttft, 50),
+        "ttft_ms_p95": _pct(ttft, 95),
+        "queue_wait_ms_p50": _pct(qwait, 50),
+        "queue_wait_ms_p95": _pct(qwait, 95),
         "fresh_compiles_after_warmup": int(fresh),
     }
 
@@ -198,7 +212,21 @@ def main():
         "token_ms_p95": full["token_ms_p95"],
         "token_ms_p99": full["token_ms_p99"],
         "ttft_ms_p50": full["ttft_ms_p50"],
+        "ttft_ms_p95": full["ttft_ms_p95"],
+        "queue_wait_ms_p50": full["queue_wait_ms_p50"],
+        "queue_wait_ms_p95": full["queue_wait_ms_p95"],
         "fresh_compiles_after_warmup": full["fresh_compiles_after_warmup"],
+        # lower-is-better latency riders bench_regress gates under
+        # LATENCY_TOLERANCE (full-concurrency level; omitted when the
+        # level produced no samples so missing-row detection can fire)
+        "latency": {
+            name: {"metric": name, "value": val, "unit": "ms",
+                   "concurrency": SLOTS}
+            for name, val in (
+                ("serving_ttft_ms_p95", full["ttft_ms_p95"]),
+                ("serving_queue_wait_ms_p95", full["queue_wait_ms_p95"]),
+            ) if val is not None
+        },
         "degraded": degraded,
         "sweep": sweep,
     }))

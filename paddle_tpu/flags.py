@@ -231,6 +231,24 @@ _DEFS: Dict[str, tuple] = {
     "serve_brownout_max_new_tokens": (int, 16,
                                       "max_new_tokens cap applied to "
                                       "admissions during brownout"),
+    # request-scoped SLO plane (serving_trace.py): terminal requests are
+    # measured against these targets and the pt_slo_* counters burn on
+    # every miss — a censored request (terminal before its first token)
+    # counts AGAINST the TTFT target, so overload cannot improve the
+    # apparent SLO. 0 = no target (the status counters stay empty; the
+    # deadline burn rows tick regardless — a request's own deadline IS
+    # its SLO).
+    "serve_slo_ttft_ms": (float, 0.0,
+                          "time-to-first-token SLO target (0 = none)"),
+    "serve_slo_token_ms": (float, 0.0,
+                           "per-token decode-latency SLO target "
+                           "(0 = none)"),
+    # bounded recently-terminated request ring served on the /requests
+    # monitor route (per-phase latency breakdowns + deadline attribution
+    # per terminal request)
+    "serve_recent_requests": (int, 256,
+                              "recently-terminated request ring "
+                              "capacity on /requests"),
     # unified retry policy (retry.py) used by fleet connect/kv/heartbeat:
     # first backoff sleep; subsequent sleeps take decorrelated jitter in
     # [base, 3*prev] capped at retry_max_delay_ms

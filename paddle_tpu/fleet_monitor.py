@@ -235,6 +235,12 @@ def registry_digest(rank: int = 0, world: int = 1,
 
     rl = _sys.modules.get("paddle_tpu.roofline")
     roofline = rl.digest_section() if rl is not None else None
+    # serving rollup (same optional-field pattern): per-replica engine
+    # rows + TTFT/token quantiles + SLO counts — the /fleet row a
+    # multi-replica router selects replicas on. Absent on ranks that
+    # never served.
+    st = _sys.modules.get("paddle_tpu.serving_trace")
+    serving_sec = st.digest_section() if st is not None else None
     digest = {
         "v": _monitor.FLEET_DIGEST_SCHEMA_VERSION,
         "ts": time.time(),
@@ -256,6 +262,8 @@ def registry_digest(rank: int = 0, world: int = 1,
     }
     if roofline is not None:
         digest["roofline"] = roofline
+    if serving_sec is not None:
+        digest["serving"] = serving_sec
     return digest
 
 

@@ -1060,6 +1060,11 @@ def build_decode_step(cfg: Optional[TransformerConfig] = None,
     # host and evicts ONLY the poisoned slot(s), the decode-path twin of
     # the numerics plane's nonfinite/maxabs reduction
     maxabs = layers.reduce_max(layers.abs(flat), dim=1)  # [S] f32
+    # the greedy token's own logit (the row max — argmax's value): the
+    # request-trace plane samples it onto decode-step trace events so a
+    # request's track shows WHAT was emitted and how confident the head
+    # was, without a second device round-trip
+    score = layers.reduce_max(flat, dim=1)  # [S] f32
 
     # liveness: host mask AND device EOS/length tracking. A dead slot
     # freezes (emits end_id, position pinned) until the next prefill
@@ -1077,8 +1082,8 @@ def build_decode_step(cfg: Optional[TransformerConfig] = None,
     layers.assign(emit_pos, output=pos)
     layers.assign(new_live, output=live)
     return {"feeds": [active], "emit": emit, "live": new_live,
-            "pos": emit_pos, "maxabs": maxabs, "state": state,
-            "config": cfg}
+            "pos": emit_pos, "maxabs": maxabs, "score": score,
+            "state": state, "config": cfg}
 
 
 def build_slot_scrub(cfg: Optional[TransformerConfig] = None,

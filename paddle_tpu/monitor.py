@@ -474,6 +474,7 @@ def reset():
     _oom_seq = 0
     with _TRACE_LOCK:
         _TRACE_RING.clear()
+        _DYN_TRACKS.clear()
     global _input_wait_s, _last_bound
     with _BOUND_LOCK:
         _input_wait_s = 0.0
@@ -492,6 +493,9 @@ def reset():
     rl = sys.modules.get("paddle_tpu.roofline")
     if rl is not None:
         rl.reset()
+    st = sys.modules.get("paddle_tpu.serving_trace")
+    if st is not None:
+        st.reset()
 
 
 def snapshot() -> Dict[str, Any]:
@@ -1109,6 +1113,9 @@ ROUTES: Dict[str, str] = {
                 "program (top ops, verdict, measured MFU)",
     "/serve": "JSON serving plane: per-engine slot/queue stats, token "
               "throughput, TTFT + per-token latency quantiles",
+    "/requests": "JSON request plane: in-flight serving requests + the "
+                 "recently-terminated ring (per-phase latency "
+                 "breakdowns, deadline attribution, SLO accounting)",
 }
 
 
@@ -1235,6 +1242,16 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
                     from paddle_tpu import serving as _serving
 
                     body = json.dumps(_serving.summary(),
+                                      sort_keys=True,
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif path == "/requests":
+                    # lazy import: serving_trace.py imports monitor.py
+                    # (it reads the serving plane via sys.modules, so a
+                    # process that never served answers an empty view)
+                    from paddle_tpu import serving_trace as _strace
+
+                    body = json.dumps(_strace.requests_view(),
                                       sort_keys=True,
                                       default=str).encode()
                     ctype = "application/json"
@@ -1487,6 +1504,12 @@ FLEET_DIGEST_FIELDS: Dict[str, tuple] = {
                  "plane: program -> {measured_mfu, verdict, source} "
                  "(roofline.digest_section); absent before the first "
                  "profile — optional, schema stays v1"),
+    "serving": ((dict, type(None)), False,
+                "per-replica serving rollup from the request plane: "
+                "engine rows (state, queue depth, active slots, token "
+                "EWMA) + TTFT/token latency quantiles + SLO counts "
+                "(serving_trace.digest_section); absent on ranks that "
+                "never served — optional, schema stays v1"),
 }
 
 
@@ -2071,6 +2094,30 @@ _TRACE_LOCK = threading.Lock()
 _TRACE_RING: collections.deque = collections.deque(
     maxlen=TRACE_RING_CAPACITY)
 
+# Dynamic per-request tracks (serving_trace.py): tids at or above this
+# base are allocated at runtime and labelled via trace_register_track;
+# the registry is bounded so the snapshot's metadata block stays small
+# when a server churns through many requests (an aged-out track keeps
+# its events — only the thread_name label is dropped).
+REQUEST_TRACK_BASE = 32
+_DYN_TRACK_CAP = 128
+_DYN_TRACKS: "collections.OrderedDict[int, str]" = collections.OrderedDict()
+
+
+def trace_register_track(tid: int, name: str):
+    """Label a dynamically allocated track: exported as thread_name
+    metadata in ``trace_snapshot``. No-op while tracing is inactive;
+    re-registering a tid replaces its label (tracks are recycled
+    round-robin by the request plane)."""
+    if not _trace_on:
+        return
+    tid = int(tid)
+    with _TRACE_LOCK:
+        _DYN_TRACKS[tid] = str(name)
+        _DYN_TRACKS.move_to_end(tid)
+        while len(_DYN_TRACKS) > _DYN_TRACK_CAP:
+            _DYN_TRACKS.popitem(last=False)
+
 # cached hot gate: telemetry on AND someone can see the trace (trace_dir
 # configured or the live endpoint up) — same visibility rule as compile
 # reports, so tracing is never on by accident
@@ -2119,12 +2166,15 @@ def _ts_us(t_perf: float) -> float:
 
 def trace_event(name: str, cat: str, t0: float,
                 t1: Optional[float] = None,
-                args: Optional[Dict[str, Any]] = None):
+                args: Optional[Dict[str, Any]] = None,
+                tid: Optional[int] = None):
     """Append one event to the timeline ring (no-op unless
     ``trace_active()``). ``t0``/``t1`` are ``time.perf_counter``
     readings: a pair makes a complete ('X') event with a duration, a
-    lone ``t0`` an instant ('i') event. Never raises — telemetry must
-    not fail a step."""
+    lone ``t0`` an instant ('i') event. ``tid`` overrides the
+    category's synthetic track — the request plane lands a request's
+    whole life on one dynamic track this way. Never raises — telemetry
+    must not fail a step."""
     if not _trace_on:
         return
     ev: Dict[str, Any] = {
@@ -2133,7 +2183,8 @@ def trace_event(name: str, cat: str, t0: float,
         "ph": "X" if t1 is not None else "i",
         "ts": _ts_us(t0),
         "pid": os.getpid(),
-        "tid": TRACE_TRACKS.get(cat, (0, ""))[0],
+        "tid": (TRACE_TRACKS.get(cat, (0, ""))[0] if tid is None
+                else int(tid)),
     }
     if t1 is not None:
         ev["dur"] = max(t1 - t0, 0.0) * 1e6
@@ -2189,6 +2240,13 @@ def trace_snapshot() -> Dict[str, Any]:
         "args": {"name": f"rank{_trace_rank} ({_HOSTNAME}:{pid})"},
     }]
     for _cat, (tid, label) in sorted(TRACE_TRACKS.items()):
+        meta_events.append({
+            "name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
+            "tid": tid, "args": {"name": label},
+        })
+    with _TRACE_LOCK:
+        dyn = sorted(_DYN_TRACKS.items())
+    for tid, label in dyn:
         meta_events.append({
             "name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
             "tid": tid, "args": {"name": label},

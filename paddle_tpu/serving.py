@@ -31,6 +31,12 @@ built on the pieces the training stack already proved:
   live endpoint serves an engine summary at ``/serve``; chaos plans can
   arm ``serve.enqueue`` / ``serve.prefill`` / ``serve.decode`` /
   ``serve.fetch`` fault sites.
+- **Request-scoped tracing** (serving_trace.py): every request carries
+  a trace id + measured per-phase latencies (queue wait / prefill /
+  decode / fetch), its whole life lands on one Chrome-trace track, the
+  terminal breakdown is served at ``/requests``, and the ``pt_slo_*``
+  counters score it against the ``serve_slo_*`` flag targets —
+  including deadline attribution on expired/rejected_early requests.
 
 Resilience (the serving analog of the training fault-tolerance plane):
 
@@ -94,6 +100,7 @@ from paddle_tpu import flags as _flags
 from paddle_tpu import monitor as _monitor
 from paddle_tpu import numerics as _numerics
 from paddle_tpu import retry as _retry
+from paddle_tpu import serving_trace as _strace
 from paddle_tpu.executor import Executor, Scope, scope_guard
 from paddle_tpu.framework import CPUPlace, TPUPlace
 
@@ -290,6 +297,23 @@ class ServeRequest:
         self.ttft_s: Optional[float] = None
         self.replays = 0  # supervised-restart replays of this request
         self.capped = False  # max_new_tokens cut by brownout
+        # request-scoped observability (serving_trace.py): measured
+        # per-phase latencies, the deadline attribution, the censored
+        # flag (terminal before first token), and the request's pinned
+        # Chrome-trace track. Plain attributes set by the engine's
+        # scheduler tick — reading a clock and storing a float keeps
+        # the telemetry-off hot path allocation-free in the new plane.
+        self.engine_id: Optional[int] = None
+        self.admit_ts: Optional[float] = None
+        self.finish_ts: Optional[float] = None
+        self.queue_wait_s: Optional[float] = None
+        self.prefill_s: Optional[float] = None
+        self.decode_s = 0.0
+        self.fetch_s = 0.0
+        self.censored = False
+        self.deadline_attr: Optional[Dict] = None
+        self.trace_tid: Optional[int] = None
+        self._replay_intake_ts: Optional[float] = None
         # set by the supervisor's replay intake; the RESET (token wipe)
         # is deferred to the rebuilt engine's admission so a replay
         # that never reaches prefill keeps its partial output
@@ -299,6 +323,12 @@ class ServeRequest:
     @property
     def done(self) -> bool:
         return self._done.is_set()
+
+    @property
+    def trace_id(self) -> str:
+        """Stable id tying the handle to its timeline track, /requests
+        rows and log lines — survives supervised-restart replays."""
+        return f"r{self.id}"
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until the request reaches a terminal outcome; returns
@@ -311,6 +341,10 @@ class ServeRequest:
     def _finish(self, outcome: str):
         self.outcome = outcome
         _M_REQUESTS.inc(labels={"outcome": outcome})
+        # the one funnel every terminal path flows through: censored
+        # TTFT metering, SLO scoring, deadline attribution, and the
+        # /requests ring record happen here, BEFORE waiters wake
+        _strace.note_terminal(self)
         self._done.set()
 
     def _reset_for_replay(self):
@@ -322,8 +356,17 @@ class ServeRequest:
         self._replay_pending = False
         self.tokens = []
         self.ttft_s = None
+        # the phase decomposition restarts with the replay; queue wait
+        # re-derives from the ORIGINAL submit at the rebuilt engine's
+        # admission, so the restart gap lands in the queue phase and
+        # the phase sum still covers the request's wall time
+        self.queue_wait_s = None
+        self.prefill_s = None
+        self.decode_s = 0.0
+        self.fetch_s = 0.0
         self.replays += 1
         _M_REPLAYED.inc()
+        _strace.note_restart(self)
 
 
 def _load_weights_into(scope: Scope, weights) -> bool:
@@ -423,7 +466,7 @@ class ServingEngine:
             self.scope.set(name, np.zeros(shape, dtype=np.dtype(dtype)))
         self._queue: "collections.deque[ServeRequest]" = collections.deque()
         self._slots = [_Slot() for _ in range(self.slots)]
-        # (LazyFetches, per-slot request snapshot, t0, retried)
+        # (LazyFetches, per-slot request snapshot, t0, retried, step)
         self._pending = None
         self._lock = threading.Lock()
         self._draining = False
@@ -501,6 +544,7 @@ class ServingEngine:
         deadline_s = (self.deadline_s if deadline_ms is None
                       else float(deadline_ms) / 1e3)
         req = ServeRequest(full, pad, want, deadline_s)
+        req.engine_id = self.engine_id
         with self._lock:
             # closed/draining re-checked under the SAME lock drain()
             # clears the queue with: a submit racing a drain must either
@@ -526,7 +570,11 @@ class ServingEngine:
                 if req.submit_ts + eta_s > req.deadline_ts:
                     # refused AT SUBMIT, never queued: queueing work
                     # that provably cannot emit one token before its
-                    # deadline only inflates every neighbor's latency
+                    # deadline only inflates every neighbor's latency.
+                    # The ESTIMATED queue wait is the refusal's whole
+                    # story — recorded so the deadline attribution can
+                    # name the phase that ate the budget.
+                    req.queue_wait_s = eta_s
                     req._finish("rejected_early")
                     _publish_gauges()
                     raise DeadlineUnmeetable(
@@ -549,6 +597,7 @@ class ServingEngine:
                 self._beat = time.perf_counter()
             self._queue.append(req)
             _publish_gauges()
+        _strace.note_submit(req)
         return req
 
     def _estimate_first_token_s(self) -> float:
@@ -746,8 +795,12 @@ class ServingEngine:
                     return
                 req = self._queue.popleft()
                 _publish_gauges()
-            if (req.deadline_ts is not None
-                    and time.perf_counter() > req.deadline_ts):
+            now = time.perf_counter()
+            if req.deadline_ts is not None and now > req.deadline_ts:
+                # the deadline elapsed while QUEUED: the measured queue
+                # wait is what ate the budget — record it before the
+                # terminal accounting attributes the expiry
+                req.queue_wait_s = now - req.submit_ts
                 req._finish("expired")
                 continue
             was_replay = req._replay_pending
@@ -764,6 +817,11 @@ class ServingEngine:
                     req.max_new_tokens = cap
                     req.capped = True
                     _M_BROWNOUT_CAPPED.inc()
+            # phase decomposition: the queue span closes at the pop
+            # (replays re-measure from the ORIGINAL submit — the
+            # restart gap is queue time from the request's view)
+            req.admit_ts = time.perf_counter()
+            req.queue_wait_s = req.admit_ts - req.submit_ts
             pre = self._progs["prefill"]
             try:
                 _F_PREFILL.hit()
@@ -778,6 +836,7 @@ class ServingEngine:
                                 np.asarray([free], np.int64),
                         },
                         fetch_list=[])
+                req.prefill_s = time.perf_counter() - req.admit_ts
             except Exception as e:
                 # the request is already off the queue and owns no slot:
                 # finish the handle before propagating — result() must
@@ -789,6 +848,7 @@ class ServingEngine:
                 raise
             self._slots[free].request = req
             _M_PREFILLS.inc()
+            _strace.note_admit(req)
             _publish_gauges()
 
     def _dispatch(self):
@@ -813,14 +873,14 @@ class ServingEngine:
                     self._progs["decode_program"],
                     feed={dec["feeds"][0].name: mask},
                     fetch_list=[dec["emit"], dec["live"], dec["pos"],
-                                dec["maxabs"]],
+                                dec["maxabs"], dec["score"]],
                     async_fetch=True)
         except Exception as e:
             self._contain_decode_error(e)
             return
         snapshot = [s.request if m else None
                     for s, m in zip(self._slots, mask)]
-        self._pending = (fetches, snapshot, t0, False)
+        self._pending = (fetches, snapshot, t0, False, self.decode_steps)
         self.decode_steps += 1
         _M_DECODE_STEPS.inc()
 
@@ -854,9 +914,10 @@ class ServingEngine:
                 if 0 <= i < self.slots:
                     req = self._slots[i].request
                     if req is not None and req.outcome is None:
+                        _strace.note_evicted(req, "fault", i)
                         self._finish_slot(i, req, "evicted")
                         _M_SLOT_EVICTIONS.inc(labels={"cause": "fault"})
-                        evicted.append(i)
+                        evicted.append((i, req))
             _publish_gauges()
         if not evicted:
             # the hint named no active slot (out of range, or already
@@ -867,14 +928,14 @@ class ServingEngine:
         self._scrub_evicted(evicted)
 
     def _contain_fetch_error(self, exc, fetches, snapshot, t0,
-                             retried) -> List[int]:
+                             retried, step) -> List:
         """Materialization-path failure policy (caller holds the lock):
         a slot-hinted error evicts the poisoned slots and re-pends the
         step's fetches for ONE retry (the healthy slots' tokens are
         still in the buffers — dropping them would fork their streams);
         a second failure or an unattributable one fails the engine.
-        Returns the evicted slots for the caller to scrub OUTSIDE the
-        lock (the scrub is a blocking device call)."""
+        Returns the evicted (slot, request) pairs for the caller to
+        scrub OUTSIDE the lock (the scrub is a blocking device call)."""
         hints = self._attribute_or_fail(exc)
         if retried:
             self._fail(exc)
@@ -885,33 +946,37 @@ class ServingEngine:
                 req = self._slots[i].request
                 if (req is not None and req.outcome is None
                         and snapshot[i] is req):
+                    _strace.note_evicted(req, "fault", i)
                     self._finish_slot(i, req, "evicted")
                     _M_SLOT_EVICTIONS.inc(labels={"cause": "fault"})
                     snapshot[i] = None
-                    evicted.append(i)
+                    evicted.append((i, req))
         if not evicted:
             # hint matched no active slot: nothing was contained (see
             # _contain_decode_error — a swallow here would livelock)
             self._fail(exc)
             raise exc
-        self._pending = (fetches, snapshot, t0, True)
+        self._pending = (fetches, snapshot, t0, True, step)
         _publish_gauges()
         return evicted
 
-    def _scrub_evicted(self, slots: List[int]):
+    def _scrub_evicted(self, slots: List):
         """Run the per-slot device scrub AFTER the engine lock is
         released — a blocking device call under the lock would wedge
         submit()/busy()/the supervisor watchdog (the exact hang the
         watchdog exists to recover from). Safe lock-free: only the one
         driver thread admits, so a freed slot cannot be re-occupied
         before its scrub runs. A FAILING scrub fails the engine: an
-        unscrubbed slot would re-poison its next occupant."""
-        for i in slots:
+        unscrubbed slot would re-poison its next occupant. ``slots``
+        carries (slot, victim request) pairs so the scrub lands on the
+        victim's timeline track."""
+        for i, req in slots:
             try:
                 self._scrub_slot_state(i)
             except Exception as e:
                 self._fail(e)
                 raise
+            _strace.note_scrub(req, i)
 
     def _fail(self, exc):
         """Mark the engine failed (unattributable decode/fetch fault:
@@ -956,17 +1021,23 @@ class ServingEngine:
                 return 0
             if self._pending is None:
                 return 0
-            fetches, snapshot, t0, retried = self._pending
+            fetches, snapshot, t0, retried, step = self._pending
             self._pending = None
         try:
+            # decode/fetch phase split: device work runs dispatch->t_f0,
+            # the host materialization t_f0->t_f1 (with async_fetch the
+            # device wait resolves inside np.asarray)
+            t_f0 = time.perf_counter()
             _F_FETCH.hit()
-            emit, live, pos, maxabs = [np.asarray(a) for a in fetches]
+            emit, live, pos, maxabs, score = [np.asarray(a)
+                                              for a in fetches]
+            t_f1 = time.perf_counter()
         except Exception as e:
             with self._lock:
                 if self._failed or self._closed:
                     return 0
                 to_scrub = self._contain_fetch_error(
-                    e, fetches, snapshot, t0, retried)
+                    e, fetches, snapshot, t0, retried, step)
             self._scrub_evicted(to_scrub)  # device call: outside lock
             return 0
         with self._lock:
@@ -992,6 +1063,12 @@ class ServingEngine:
                                           + 0.2 * step_s)
             emitted = 0
             to_scrub = []
+            # per-request phase accumulation: the step's device wall is
+            # decode time, the host materialization fetch time — every
+            # request served by this step pays the same split
+            decode_d = t_f0 - t0
+            fetch_d = t_f1 - t_f0
+            traced = _monitor.trace_step_sampled(step)
             for i, req in enumerate(snapshot):
                 if req is None or req.outcome is not None:
                     continue
@@ -1006,12 +1083,19 @@ class ServingEngine:
                         program_uid=self._progs["decode_program"]._uid,
                         step=self.decode_steps, kind="serve",
                         maxabs=float(maxabs[i]))
+                    _strace.note_evicted(req, "nonfinite", i)
                     self._finish_slot(i, req, "error")
-                    to_scrub.append(i)
+                    to_scrub.append((i, req))
                     _M_SLOT_EVICTIONS.inc(labels={"cause": "nonfinite"})
                     continue
+                req.decode_s += decode_d
+                req.fetch_s += fetch_d
                 tok = int(emit[i])
                 alive = bool(live[i])
+                if traced:
+                    _strace.note_decode_step(
+                        req, step, t0, t_f0, t_f1, tok, int(pos[i]),
+                        float(score[i]))
                 if not alive and tok == self.end_id:
                     # EOS (or a dead-slot freeze): terminal, token dropped
                     self._finish_slot(i, req, "completed")
@@ -1076,6 +1160,8 @@ class ServingEngine:
         replay would turn one engine fault into request failures). The
         partial output survives until the replay actually re-prefills;
         a dead intake finishes the handle 'error' with it intact."""
+        req._replay_intake_ts = time.perf_counter()
+        req.engine_id = self.engine_id
         with self._lock:
             if self._closed or self._failed:
                 req._finish("error")

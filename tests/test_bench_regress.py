@@ -242,3 +242,47 @@ def test_degraded_serving_family_gates_with_wide_tolerance():
     (f,) = bench_regress.check(missing, history)
     assert f["metric"] == "serving_degraded_tokens_per_sec"
     assert f.get("missing") is True
+
+
+def test_serving_latency_riders_gate_lower_is_better():
+    """The serving TTFT/queue-wait p95 riders (bench_serving.py's
+    ``latency`` block) gate in the OPPOSITE direction: best is the
+    MINIMUM across history, and a fresh value rising more than the
+    allowlist tolerance above it is a regression. A plain ``ms`` unit
+    outside the allowlist still never gates."""
+    assert bench_regress.LATENCY_TOLERANCE[
+        "serving_ttft_ms_p95"] == pytest.approx(0.50)
+
+    def row(ttft, qwait):
+        return bench_regress.flatten_row(_row(
+            5000.0, metric="serving_decode_tokens_per_sec",
+            latency={
+                "ttft": {"metric": "serving_ttft_ms_p95",
+                         "value": ttft, "unit": "ms"},
+                "qwait": {"metric": "serving_queue_wait_ms_p95",
+                          "value": qwait, "unit": "ms"},
+            }))
+
+    history = [("r06", row(100.0, 40.0)), ("r07", row(80.0, 50.0))]
+    # best = min across history (80 / 40); +50% boundaries 120 / 60
+    found = {f["metric"]: f
+             for f in bench_regress.check(row(130.0, 70.0), history)}
+    assert set(found) == {"serving_ttft_ms_p95",
+                          "serving_queue_wait_ms_p95"}
+    f = found["serving_ttft_ms_p95"]
+    assert f["direction"] == "above"
+    assert f["best"] == 80.0 and f["best_round"] == "r07"
+    assert f["ratio"] == pytest.approx(130.0 / 80.0)
+    # inside the envelope (and improvements) pass
+    assert bench_regress.check(row(115.0, 55.0), history) == []
+    assert bench_regress.check(row(10.0, 5.0), history) == []
+    # carried-by-history latency rows missing from fresh are findings
+    bare = bench_regress.flatten_row(_row(
+        5000.0, metric="serving_decode_tokens_per_sec"))
+    found = {f["metric"]: f for f in bench_regress.check(bare, history)}
+    assert found["serving_ttft_ms_p95"]["missing"] is True
+    assert found["serving_ttft_ms_p95"]["tolerance"] == pytest.approx(0.50)
+    # an un-allowlisted ms rider never gates, even when it balloons
+    hist2 = [("r01", {"tile_ms_p95": {"value": 1.0, "unit": "ms"}})]
+    assert bench_regress.check(
+        {"tile_ms_p95": {"value": 99.0, "unit": "ms"}}, hist2) == []

@@ -181,6 +181,50 @@ def test_registry_digest_roofline_section_optional_and_validated():
     assert view["ranks"]["0"]["roofline"] == d["roofline"]
 
 
+def test_registry_digest_serving_section_optional_and_validated():
+    """The digest's `serving` section (optional field — schema stays
+    v1): absent on ranks that never served, a per-replica request-plane
+    rollup once the recently-terminated ring has a record, and digests
+    WITHOUT the field still validate (backward compatibility with
+    pre-serving publishers)."""
+    import types
+
+    from paddle_tpu import serving, serving_trace
+
+    monitor.enable()
+    assert not list(serving._ENGINES)  # a leaked engine is a test bug
+    d = fleet_monitor.registry_digest(rank=0, world=2)
+    assert "serving" not in d  # this rank never served
+    monitor.validate_fleet_digest(d)
+
+    # one terminal request through the real recording path
+    now = time.perf_counter()
+    req = types.SimpleNamespace(
+        outcome="completed", ttft_s=0.01, tokens=[5, 7], decode_s=0.02,
+        fetch_s=0.001, queue_wait_s=0.005, prefill_s=0.004,
+        submit_ts=now - 0.05, deadline_ts=None, replays=0, capped=False,
+        censored=False, deadline_attr=None, trace_id="r777", id=777,
+        engine_id=9, trace_tid=None)
+    serving_trace.note_terminal(req)
+
+    d = fleet_monitor.registry_digest(rank=1, world=2)
+    monitor.validate_fleet_digest(d)
+    sec = d["serving"]
+    assert sec["recent"] == 1 and sec["engines"] == {}
+    assert set(sec["slo"]) == {"targets_ms", "ttft", "token",
+                               "ttft_censored", "burn"}
+    assert set(sec["ttft_ms"]) == {"p50", "p95", "p99"}
+    # the rollup rides aggregation into the per-rank /fleet rows
+    store, lock = {}, threading.Lock()
+    store["fleet/metrics/g0/1"] = json.dumps(d).encode()
+    f = _stub_fleet(1, 2, store, lock)
+    view = fleet_monitor.aggregate(f)
+    assert view["ranks"]["1"]["serving"]["recent"] == 1
+    # backward compatibility: a digest without the section validates
+    del d["serving"]
+    monitor.validate_fleet_digest(d)
+
+
 def test_publish_rides_heartbeat_and_rate_limits():
     monitor.enable()
     store, lock = {}, threading.Lock()

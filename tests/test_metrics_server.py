@@ -241,3 +241,50 @@ def test_metrics_port_flag_autostarts_server():
     port = monitor.serve(0)
     flags.set_flags({"metrics_port": port})  # watcher: server already up
     assert monitor.server_address() == ("127.0.0.1", port)
+
+
+def test_requests_and_serve_routes_round_trip():
+    """/requests serves the live request plane (in-flight table +
+    recently-terminated ring + SLO rollup) and /serve the engine
+    summary, both matching the in-process views after real traffic."""
+    from paddle_tpu import serving, serving_trace
+    from paddle_tpu.models import transformer as T
+
+    flags.set_flags({"telemetry": True})
+    cfg = T.TransformerConfig(
+        src_vocab_size=37, trg_vocab_size=41, max_length=64,
+        d_model=16, d_inner=32, n_head=2, n_layer=1,
+        dropout=0.0, label_smooth_eps=0.0)
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        T.build(cfg, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    eng = serving.ServingEngine(cfg, scope, slots=2, src_len=8,
+                                max_len=10, bos_id=0, end_id=1)
+    rng = np.random.RandomState(5)
+    reqs = [eng.submit(rng.randint(2, 37, (6,)).astype(np.int64),
+                       max_new_tokens=3) for _ in range(3)]
+    eng.run_until_idle()
+    port = monitor.serve(0)
+    status, ctype, body = _get(port, "/requests")
+    assert status == 200 and ctype == "application/json"
+    served = json.loads(body)
+    assert served["v"] == serving_trace.REQUEST_RECORD_SCHEMA_VERSION
+    assert served["inflight"] == []
+    assert {r["trace_id"] for r in served["recent"]} == {
+        q.trace_id for q in reqs}
+    for rec in served["recent"]:
+        assert rec["outcome"] in ("completed", "length")
+        assert set(rec["phases_ms"]) == set(serving_trace.PHASES)
+    assert served["slo"] == json.loads(
+        json.dumps(serving_trace.slo_summary()))
+    # /serve still answers with the aggregate engine summary
+    status, ctype, body = _get(port, "/serve")
+    assert status == 200 and ctype == "application/json"
+    summary = json.loads(body)
+    assert any(row["engine_id"] == eng.engine_id
+               for row in summary["engines"])
+    eng.close()

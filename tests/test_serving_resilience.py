@@ -406,6 +406,7 @@ def weights_mid():
     return cfg, scope
 
 
+@pytest.mark.multidevice_fragile
 def test_overload_drill_p99_and_no_hangs(weights_mid, telemetry):
     """The overload acceptance drill: submit rate >= 2x capacity —
     unmeetable deadlines are refused at submit (rejected_early, never

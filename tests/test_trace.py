@@ -523,3 +523,59 @@ def test_mnist_three_step_phase_breakdown_and_trace(tmp_path):
     phase_names = {e["name"] for e in doc["traceEvents"]
                    if e.get("cat") == "phase"}
     assert phase_names == set(monitor.STEP_PHASES)
+
+
+# --------------------------------------------------------------------------
+# dynamic request tracks (serving request plane)
+# --------------------------------------------------------------------------
+
+def test_dynamic_request_tracks_schema_and_metadata(tmp_path):
+    """Per-request timeline tracks: trace_event's tid override lands
+    events on a dynamic track (>= REQUEST_TRACK_BASE), the registered
+    label is exported as thread_name metadata, and the snapshot still
+    conforms to the Chrome schema."""
+    flags.set_flags({"telemetry": True, "trace_dir": str(tmp_path)})
+    base = monitor.REQUEST_TRACK_BASE
+    monitor.trace_register_track(base, "req r1")
+    monitor.trace_register_track(base + 1, "req r2")
+    monitor.trace_event("a", "request", 1.0, 2.0, tid=base)
+    monitor.trace_event("b", "request", 1.5, tid=base + 1)
+    monitor.trace_event("c", "request", 2.5, 3.0, tid=base)
+    doc = monitor.trace_snapshot()
+    _assert_chrome_schema(doc["traceEvents"])
+    by_name = {e["name"]: e for e in doc["traceEvents"] if e["ph"] != "M"}
+    assert by_name["a"]["tid"] == base
+    assert by_name["b"]["tid"] == base + 1
+    metas = {e["tid"]: e["args"]["name"]
+             for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert metas[base] == "req r1" and metas[base + 1] == "req r2"
+    # re-registering a recycled tid replaces its label
+    monitor.trace_register_track(base, "req r9")
+    metas = {e["tid"]: e["args"]["name"]
+             for e in monitor.trace_snapshot()["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert metas[base] == "req r9"
+
+
+def test_dynamic_track_label_set_is_bounded(tmp_path):
+    """Track labels are a bounded set: past _DYN_TRACK_CAP the oldest
+    registration ages out (its events keep their tid — only the
+    thread_name row is dropped). Inactive tracing registers nothing."""
+    flags.set_flags({"telemetry": True, "trace_dir": str(tmp_path)})
+    base = monitor.REQUEST_TRACK_BASE
+    n = monitor._DYN_TRACK_CAP + 7
+    for i in range(n):
+        monitor.trace_register_track(base + i, f"req r{i}")
+    metas = [e for e in monitor.trace_snapshot()["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"
+             and e["tid"] >= base]
+    assert len(metas) == monitor._DYN_TRACK_CAP
+    names = {e["args"]["name"] for e in metas}
+    assert "req r0" not in names and f"req r{n - 1}" in names
+    # inactive: registration is a no-op, reset clears the labels
+    monitor.reset()
+    flags.set_flags({"telemetry": False, "trace_dir": ""})
+    monitor.trace_register_track(base, "ghost")
+    with monitor._TRACE_LOCK:
+        assert monitor._DYN_TRACKS == {}
