@@ -30,11 +30,39 @@ lower-is-better latency riders that bench_regress.py gates under
 ``LATENCY_TOLERANCE`` — a latency family carried by history but
 missing from a fresh row is itself a finding.
 
+A **fleet** row rides along (fleet_serving.py): a ServingFleet of
+``PT_BENCH_SERVE_REPLICAS`` routed replicas vs a fleet of ONE at the
+SAME offered load (closed loop at replicas*slots in-flight over
+2*replicas*slots requests, refusals retried so every fleet size
+completes the identical work and the walls compare sustainable rate) —
+aggregate tokens/s (``serving_fleet_tokens_per_sec``, gated by
+bench_regress.py at the degraded-row envelope), per-token p99
+(``serving_fleet_token_ms_p99``, a lower-is-better latency rider),
+``shed`` = bounded-queue refusal events before retry (the backpressure
+signal; ``shed_rate`` = refusals per offered request, can exceed 1),
+and ``vs_single`` — the fleet's tokens/s over the single replica's at
+the same offered load: the measured multiple of single-replica
+sustainable throughput the fleet absorbs. The fleet section
+arms a temporary persistent compile cache so replicas 2..N spin up
+through the disk-tier warm start (the autoscaler's path) instead of
+recompiling.
+
+CPU-measured caveat: on one shared host every replica's loop thread
+dispatches through the same cores and interpreter lock, so
+``vs_single`` < 1 is EXPECTED here — the throughput multiple is a
+device-parallel signal and must be re-measured on TPU hardware where
+each replica owns its devices. The CPU-valid absorption signal is the
+refusal comparison: the N-replica fleet takes the offered load with
+``shed == 0`` while the fleet of one spins on backpressure
+(``single.shed`` large) for the SAME load.
+
 Env knobs: ``PT_BENCH_CPU=1`` forces the CPU backend;
 ``PT_BENCH_SERVE_SIZE=tiny|base`` picks the model (tiny for CPU smokes);
 ``PT_BENCH_SERVE_SLOTS`` (default 8), ``PT_BENCH_SERVE_SRC`` source
 length (default 32), ``PT_BENCH_SERVE_NEW`` max new tokens per request
-(default 24); ``PT_BENCH_SERVE_DEGRADED=0`` skips the degraded row.
+(default 24); ``PT_BENCH_SERVE_DEGRADED=0`` skips the degraded row;
+``PT_BENCH_SERVE_REPLICAS`` (default 3) sizes the fleet row and
+``PT_BENCH_SERVE_FLEET=0`` skips it.
 """
 
 from __future__ import annotations
@@ -50,6 +78,7 @@ SLOTS = int(os.environ.get("PT_BENCH_SERVE_SLOTS", "8"))
 SRC_LEN = int(os.environ.get("PT_BENCH_SERVE_SRC", "32"))
 MAX_NEW = int(os.environ.get("PT_BENCH_SERVE_NEW", "24"))
 SIZE = os.environ.get("PT_BENCH_SERVE_SIZE", "base")
+REPLICAS = int(os.environ.get("PT_BENCH_SERVE_REPLICAS", "3"))
 
 
 def log(msg):
@@ -140,6 +169,85 @@ def _sweep_level(cfg, scope, concurrency, n_requests, monitor):
     }
 
 
+def _fleet_level(cfg, scope, replicas, concurrency, n_requests):
+    """Drive one fleet size at a fixed offered load (closed loop with
+    ``concurrency`` requests in flight); returns the measured row.
+
+    The engines run on their own supervisor loop threads, so per-token
+    latency here is each request's accumulated device decode wall
+    divided by its token count (the request plane's phase attribution),
+    not a host-stepped dispatch wall like the single-engine sweep."""
+    from paddle_tpu import fleet_serving
+
+    fleet = fleet_serving.ServingFleet(
+        cfg, scope, replicas=replicas, slots=SLOTS, src_len=SRC_LEN,
+        max_len=SRC_LEN + MAX_NEW + 1, queue_depth=SLOTS)
+    rng = np.random.RandomState(23)
+    srcs = [rng.randint(2, cfg.src_vocab_size, (SRC_LEN,)).astype(np.int64)
+            for _ in range(n_requests)]
+    try:
+        # warmup: one request per replica compiles (or disk-loads) every
+        # replica's prefill + decode in parallel before the timed window
+        warm = [fleet.submit(srcs[i % len(srcs)], max_new_tokens=2)
+                for i in range(replicas)]
+        for w in warm:
+            w.result(timeout=1200)
+
+        inflight = []
+        pending = list(srcs)
+        shed = 0
+        t0 = time.perf_counter()
+        while pending or any(not fr.done for fr in inflight):
+            while (pending
+                   and sum(1 for fr in inflight if not fr.done)
+                   < concurrency):
+                src = pending.pop(0)
+                try:
+                    inflight.append(fleet.submit(src,
+                                                 max_new_tokens=MAX_NEW))
+                except Exception:
+                    # bounded queues refused: offered > sustainable.
+                    # Count the backpressure event and retry next tick
+                    # (closed loop with retry — every fleet size serves
+                    # the SAME completed load, so the walls are the
+                    # sustainable-throughput comparison)
+                    shed += 1
+                    pending.insert(0, src)
+                    break
+            time.sleep(0.001)
+        wall = time.perf_counter() - t0
+        tokens = 0
+        token_lat = []
+        for fr in inflight:
+            n = len(fr.tokens)
+            tokens += n
+            if n and fr._sr.decode_s > 0.0:
+                token_lat.extend([fr._sr.decode_s / n] * n)
+        done = sum(1 for fr in inflight
+                   if fr.outcome in ("completed", "length"))
+        stats = fleet.stats()
+    finally:
+        fleet.close()
+    lat = np.asarray(token_lat) if token_lat else np.asarray([0.0])
+    offered = len(srcs)
+    return {
+        "replicas": replicas,
+        "offered_requests": offered,
+        "offered_concurrency": concurrency,
+        "requests": done,
+        "tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 2) if wall else 0.0,
+        "token_ms_p50": round(float(np.percentile(lat, 50)) * 1e3, 3),
+        "token_ms_p99": round(float(np.percentile(lat, 99)) * 1e3, 3),
+        # refusal EVENTS off the bounded queues (retried, so the load
+        # still completes); the rate is refusals per offered request
+        # and exceeds 1 when a fleet size has to retry-spin hard
+        "shed": shed,
+        "shed_rate": round(shed / offered, 3),
+        "failovers": stats["failovers"],
+    }
+
+
 def main():
     _configure_platform()
     import jax
@@ -196,6 +304,49 @@ def main():
                                  / full["tokens_per_sec"], 3)
                            if full["tokens_per_sec"] else 0.0),
         }
+    # fleet row: N routed replicas vs ONE at the same offered load,
+    # behind a temporary persistent compile cache so replicas 2..N (and
+    # the fleet-of-one rerun) warm-start from disk instead of paying N
+    # fresh XLA compiles
+    fleet_row = None
+    if os.environ.get("PT_BENCH_SERVE_FLEET", "1") == "1" and REPLICAS > 1:
+        import shutil
+        import tempfile
+
+        conc = REPLICAS * SLOTS
+        n_req = 2 * conc
+        cc_dir = tempfile.mkdtemp(prefix="pt_bench_fleet_cc_")
+        old_cc = flags.get_flag("compile_cache_dir")
+        flags.set_flags({"compile_cache_dir": cc_dir})
+        try:
+            multi = _fleet_level(cfg, scope, REPLICAS, conc, n_req)
+            log(f"fleet x{REPLICAS}: {multi}")
+            single = _fleet_level(cfg, scope, 1, conc, n_req)
+            log(f"fleet x1 (same offered load): {single}")
+        finally:
+            flags.set_flags({"compile_cache_dir": old_cc})
+            shutil.rmtree(cc_dir, ignore_errors=True)
+        fleet_row = {
+            "metric": "serving_fleet_tokens_per_sec",
+            "value": multi["tokens_per_sec"],
+            "unit": "tokens/sec",
+            **{k: multi[k] for k in (
+                "replicas", "offered_requests", "offered_concurrency",
+                "requests", "token_ms_p50", "token_ms_p99", "shed",
+                "shed_rate", "failovers")},
+            # both fleet sizes complete the SAME offered load (refusals
+            # retried), so the tokens/s ratio is the measured multiple
+            # of single-replica sustainable throughput the fleet
+            # absorbs — meaningful on device-parallel hardware; on a
+            # shared CPU host the replicas contend for the same cores,
+            # vs_single < 1 is expected, and the absorption evidence is
+            # shed == 0 here vs single["shed"] backpressure spins
+            "vs_single": (round(multi["tokens_per_sec"]
+                                / single["tokens_per_sec"], 3)
+                          if single["tokens_per_sec"] else 0.0),
+            "single": {k: v for k, v in single.items()},
+        }
+
     print(json.dumps({
         "metric": "serving_decode_tokens_per_sec",
         "value": full["tokens_per_sec"],
@@ -225,9 +376,12 @@ def main():
             for name, val in (
                 ("serving_ttft_ms_p95", full["ttft_ms_p95"]),
                 ("serving_queue_wait_ms_p95", full["queue_wait_ms_p95"]),
+                ("serving_fleet_token_ms_p99",
+                 fleet_row["token_ms_p99"] if fleet_row else None),
             ) if val is not None
         },
         "degraded": degraded,
+        "fleet": fleet_row,
         "sweep": sweep,
     }))
 

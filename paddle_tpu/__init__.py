@@ -33,6 +33,7 @@ from paddle_tpu import (  # noqa: F401
     debugger,
     faults,
     flags,
+    fleet_serving,
     inference,
     install_check,
     monitor,

@@ -70,6 +70,7 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
+import threading
 import time
 import warnings
 from collections import OrderedDict
@@ -604,7 +605,11 @@ def store(spec: Spec, comp) -> bool:
             },
         }
         os.makedirs(_dir, exist_ok=True)
-        tmp = spec.path + f".tmp.{os.getpid()}"
+        # pid alone is not unique: concurrent serving replicas (fleet
+        # supervisor loop threads) store the same digest from one
+        # process, and a shared tmp name turns the second rename into
+        # a FileNotFoundError store failure
+        tmp = spec.path + f".tmp.{os.getpid()}.{threading.get_ident()}"
         with open(tmp, "wb") as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
             f.flush()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import threading
 import time
 
 from typing import Any, Dict, List, Optional, Sequence, Union
@@ -205,19 +206,43 @@ class Scope:
 _global_scope = Scope()
 
 
+class _ScopeTLS(threading.local):
+    def __init__(self):
+        self.stack: List[Scope] = []
+
+
+_scope_tls = _ScopeTLS()
+
+
 def global_scope() -> Scope:
-    return _global_scope
+    stack = _scope_tls.stack
+    return stack[-1] if stack else _global_scope
 
 
 @contextlib.contextmanager
 def scope_guard(scope: Scope):
-    """Swap the global scope (reference: fluid.executor.scope_guard)."""
-    global _global_scope
-    old, _global_scope = _global_scope, scope
-    try:
-        yield
-    finally:
-        _global_scope = old
+    """Swap the ambient scope (reference: fluid.executor.scope_guard).
+
+    A guard entered on a worker thread is THREAD-LOCAL: concurrent
+    engines (serving-fleet replicas each driving their own supervisor
+    loop thread) must not resolve each other's scopes through a shared
+    global — a torn swap hands one engine another engine's decode
+    state, or a stateless scope mid-step. The main thread keeps the
+    legacy process-global swap so unguarded worker threads still
+    inherit the main thread's guarded scope."""
+    if threading.current_thread() is threading.main_thread():
+        global _global_scope
+        old, _global_scope = _global_scope, scope
+        try:
+            yield
+        finally:
+            _global_scope = old
+    else:
+        _scope_tls.stack.append(scope)
+        try:
+            yield
+        finally:
+            _scope_tls.stack.pop()
 
 
 def _prng_impl():

@@ -211,6 +211,24 @@ BUILTIN_SITES = {
                    "contained eviction with the step's remaining "
                    "fetches retried once; unhinted raise = engine-"
                    "fatal, the supervisor-restart seam)",
+    "router.route": "fleet router replica selection, per submit() "
+                    "(fleet_serving.py ServingFleet.submit; raise = a "
+                    "routing-plane failure the caller must see — no "
+                    "replica is charged; delay = slow routing under "
+                    "the deadline budget)",
+    "router.replica_crash": "fleet pump tick, once per tick "
+                            "(fleet_serving.py; raise(replica=N) = "
+                            "hard-kill the N-th live replica (id "
+                            "order, default 0) mid-flight — the kill-"
+                            "one-replica drill: its supervisor is "
+                            "harvested and every in-flight request "
+                            "replays on survivors byte-identically)",
+    "router.handoff": "rolling-rollout drain of one replica, pre-"
+                      "handoff (fleet_serving.py _retire_replica; "
+                      "raise = the drain tears mid-rollout — the "
+                      "replica is hard-harvested instead and its "
+                      "requests still re-home on survivors; delay = "
+                      "slow handoff under the rollout timeout)",
 }
 
 

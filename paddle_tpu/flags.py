@@ -249,6 +249,36 @@ _DEFS: Dict[str, tuple] = {
     "serve_recent_requests": (int, 256,
                               "recently-terminated request ring "
                               "capacity on /requests"),
+    # serving fleet (fleet_serving.py): the router's autoscaler. Off by
+    # default — a ServingFleet holds the replica count it was built
+    # with; on, the pump scales up when the aggregate queue occupancy
+    # across serving replicas has been >= scale_up_queue_factor of
+    # aggregate queue capacity for autoscale_window consecutive pump
+    # ticks (up to max_replicas), and drains-then-retires one replica
+    # after scale_down_idle_ticks consecutive fully-idle ticks (down to
+    # min_replicas). Spin-up goes through the persistent compile cache:
+    # a warm replica joins with zero fresh XLA compiles.
+    "serve_fleet_autoscale": (bool, False,
+                              "ServingFleet queue-pressure autoscaling"),
+    "serve_fleet_min_replicas": (int, 1,
+                                 "autoscale floor on fleet replicas"),
+    "serve_fleet_max_replicas": (int, 8,
+                                 "autoscale ceiling on fleet replicas"),
+    "serve_fleet_scale_up_queue_factor": (
+        float, 0.75, "aggregate queue-occupancy fraction that counts a "
+                     "pump tick as saturated"),
+    "serve_fleet_autoscale_window": (int, 8,
+                                     "consecutive saturated pump ticks "
+                                     "before a replica spins up"),
+    "serve_fleet_scale_down_idle_ticks": (
+        int, 64, "consecutive idle pump ticks before one replica is "
+                 "drained and retired"),
+    # rolling-rollout / retire drain budget: a draining replica gets
+    # this long to finish its in-flight set before the router harvests
+    # the leftovers and re-homes them on survivors
+    "serve_fleet_handoff_timeout_ms": (int, 30_000,
+                                       "fleet drain-handoff budget per "
+                                       "replica"),
     # unified retry policy (retry.py) used by fleet connect/kv/heartbeat:
     # first backoff sleep; subsequent sleeps take decorrelated jitter in
     # [base, 3*prev] capped at retry_max_delay_ms

@@ -1108,7 +1108,9 @@ ROUTES: Dict[str, str] = {
     "/lint": "JSON static-verifier plane: latest lint record per program",
     "/trace": "Chrome-trace JSON timeline (Perfetto-loadable)",
     "/fleet": "JSON cluster view: per-rank digests, heartbeat ages, "
-              "stragglers, OOM reports",
+              "stragglers, OOM reports + the serving-fleet router "
+              "section (per-replica state, queue depth, generation "
+              "tag, last-heartbeat age) when a ServingFleet is live",
     "/profile": "JSON roofline plane: latest device profile per "
                 "program (top ops, verdict, measured MFU)",
     "/serve": "JSON serving plane: per-engine slot/queue stats, token "
@@ -1226,7 +1228,16 @@ def serve(port: Optional[int] = None, host: str = "127.0.0.1") -> int:
                     # lazy import: fleet_monitor.py imports monitor.py
                     from paddle_tpu import fleet_monitor as _fm
 
-                    body = json.dumps(_fm.cluster_view(), sort_keys=True,
+                    view = _fm.cluster_view()
+                    # serving-fleet rollup only when that plane is
+                    # loaded (lazy — fleet_serving imports monitor)
+                    fs = sys.modules.get("paddle_tpu.fleet_serving")
+                    if fs is not None:
+                        sfleet = fs.fleet_view()
+                        if sfleet is not None:
+                            view = dict(view)
+                            view["serving_fleet"] = sfleet
+                    body = json.dumps(view, sort_keys=True,
                                       default=str).encode()
                     ctype = "application/json"
                 elif path == "/profile":

@@ -676,6 +676,26 @@ class ServingEngine:
             _note_engine_state(self.engine_id, "draining")
         return True
 
+    def handoff_queued(self) -> List[ServeRequest]:
+        """Fleet-rollout front half of a drain: stop admissions, but
+        TAKE the queued-but-unadmitted requests instead of finishing
+        them 'drained' — the router re-homes them on another replica,
+        so a rolling weight rollout rejects nothing. The in-flight set
+        keeps decoding (whoever drives step() finishes it). Returns []
+        on a closed engine."""
+        out: List[ServeRequest] = []
+        with self._lock:
+            if self._closed:
+                return out
+            self._draining = True
+            while self._queue:
+                r = self._queue.popleft()
+                if r.outcome is None:
+                    out.append(r)
+            _publish_gauges()
+            _note_engine_state(self.engine_id, "draining")
+        return out
+
     def drain(self, timeout_s: float = 30.0) -> bool:
         """Graceful drain: stop admissions, finish the in-flight set.
         Queued-but-unadmitted requests finish with outcome 'drained'.
@@ -1247,9 +1267,18 @@ class EngineSupervisor:
                  max_restarts: Optional[int] = None,
                  restart_policy: Optional["_retry.RetryPolicy"] = None,
                  restart_deadline_s: float = 60.0,
-                 poll_s: float = 0.02, **engine_kwargs):
+                 poll_s: float = 0.02,
+                 on_handoff=None, **engine_kwargs):
         self._cfg = cfg
         self._weights = weights
+        # fleet seam: called with the pending request list when this
+        # supervisor fails TERMINALLY (restart budget exhausted or
+        # rebuild failed). A truthy return means the callee took
+        # ownership (the fleet router replays them on survivors);
+        # otherwise they finish 'error' as before. Called under
+        # self._lock — the callee must only hand the list off (no
+        # synchronous replay, no supervisor calls).
+        self._on_handoff = on_handoff
         self._engine_kwargs = dict(engine_kwargs)
         self.wedge_timeout_s = (
             float(_flags.get_flag("serve_wedge_timeout_ms"))
@@ -1350,6 +1379,83 @@ class EngineSupervisor:
                 return False
             time.sleep(self._poll_s)
 
+    def enqueue_replay(self, req: ServeRequest) -> bool:
+        """Fleet failover intake: accept an already-admitted request
+        harvested from ANOTHER replica. Bypasses backpressure and
+        admission control exactly like the supervised-restart replay
+        path — the request was admitted once; greedy decode keeps the
+        replayed stream byte-identical. Returns False (handle
+        untouched) when this supervisor cannot take it, so the router
+        can try the next survivor."""
+        with self._lock:
+            if self._closed:
+                return False
+            eng = self._engine
+            if eng._closed or eng._failed:
+                # mid-replacement: let the router retry rather than
+                # racing the rebuilt engine's installation
+                return False
+            self.replayed += 1
+        eng._enqueue_replay(req)
+        self._work.set()
+        # a fault racing the intake can still finish the handle
+        # 'error'; outcome-less means the engine owns it now
+        return req.outcome is None or req.done
+
+    def harvest(self) -> List[ServeRequest]:
+        """Fleet failover: terminally stop this supervisor and TAKE
+        every pending (outcome-less) request instead of finishing it —
+        in-flight first (their admission order), then the queue — so
+        the router can replay the set on surviving replicas (partial
+        outputs intact until each replay re-prefills). Idempotent: a
+        second call returns []."""
+        with self._lock:
+            if self._closed:
+                return []
+            self._closed = True
+            self._gen += 1  # stops the loop thread at its next check
+            eng = self._engine
+        self._work.set()
+        for t in (self._loop_thread, self._watch_thread):
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+        pending = eng._harvest_for_replay()
+        try:
+            eng.close(drain_timeout_s=0.0)
+        except Exception:
+            pass
+        return pending
+
+    def handoff(self, timeout_s: float = 30.0) -> List[ServeRequest]:
+        """Rolling-rollout drain: stop admissions, immediately take the
+        queued-but-unadmitted requests (the router re-homes them on
+        another replica instead of finishing them 'drained'), give the
+        loop thread up to ``timeout_s`` to finish the in-flight set,
+        then harvest whatever remains. Terminal for this supervisor;
+        returns every request the caller must re-home (possibly [])."""
+        t0 = time.perf_counter()
+        moved: List[ServeRequest] = []
+        swept = set()
+        while True:
+            with self._lock:
+                if self._closed:
+                    break
+                eng = self._engine
+            if id(eng) not in swept:
+                # re-applied to the rebuilt engine when a supervised
+                # restart lands mid-handoff (its replay intake holds
+                # the old engine's queue)
+                swept.add(id(eng))
+                moved.extend(eng.handoff_queued())
+                self._work.set()
+            if not eng.busy() and eng is self.engine:
+                break
+            if time.perf_counter() - t0 > timeout_s:
+                break
+            time.sleep(self._poll_s)
+        moved.extend(self.harvest())
+        return moved
+
     def close(self, drain_timeout_s: float = 30.0):
         """Drain, stop the loop + watchdog threads, close the engine.
         Every still-pending handle is finished — result() never hangs
@@ -1442,6 +1548,24 @@ class EngineSupervisor:
             self._restart_locked(
                 eng, reason=f"{type(exc).__name__}: {exc}")
 
+    def _fail_pending_locked(self, pending: List[ServeRequest]):
+        """Terminal-failure epilogue: offer the pending set to the
+        fleet (``on_handoff``) before failing it — a router with
+        surviving replicas turns a dead supervisor into failovers
+        instead of request errors. Caller holds self._lock."""
+        if pending and self._on_handoff is not None:
+            try:
+                if self._on_handoff(list(pending)):
+                    return
+            except Exception as e:  # the fleet must not kill teardown
+                warnings.warn(
+                    f"serving supervisor: on_handoff failed "
+                    f"({type(e).__name__}: {e}); failing "
+                    f"{len(pending)} pending request(s)",
+                    RuntimeWarning)
+        for r in pending:
+            r._finish("error")
+
     def _restart_locked(self, old: ServingEngine, reason: str):
         """Tear down + rebuild + replay. Caller holds self._lock."""
         pending = old._harvest_for_replay()
@@ -1450,8 +1574,7 @@ class EngineSupervisor:
                 f"serving supervisor: restart budget "
                 f"({self.max_restarts}) exhausted ({reason}); failing "
                 f"{len(pending)} pending request(s)", RuntimeWarning)
-            for r in pending:
-                r._finish("error")
+            self._fail_pending_locked(pending)
             self._closed = True
             self._gen += 1
             try:
@@ -1482,8 +1605,7 @@ class EngineSupervisor:
                 f"serving supervisor: engine rebuild failed after "
                 f"retries ({type(e).__name__}: {e}); failing "
                 f"{len(pending)} pending request(s)", RuntimeWarning)
-            for r in pending:
-                r._finish("error")
+            self._fail_pending_locked(pending)
             self._closed = True
             self._gen += 1
             return

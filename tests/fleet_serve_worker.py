@@ -1,0 +1,95 @@
+"""Subprocess worker for the warm fleet spin-up drill
+(tests/test_fleet_serving.py): one fresh "fleet host" process that
+
+1. starts a single-replica ServingFleet with the persistent compile
+   cache armed (``compile_cache_dir`` flag) and serves two requests,
+2. scales OUT by one replica (the autoscaler's spin-up path) and
+   serves two more through the router,
+
+and prints ONE JSON line with the compile-cache accounting and the
+token streams. The in-process claim: the scaled-up replica shares the
+fleet's geometry, so its prefill + decode executables resolve from the
+cache the first replica just populated — the spin-up itself adds ZERO
+disk-tier misses even on a cold cache. Run the worker twice against
+the same cache dir and the second (warm) process must resolve EVERY
+executable from disk — misses == 0 — with byte-identical tokens: the
+cross-host warm-start contract fleet autoscaling rides.
+
+Determinism contract (same as tests/serving_worker.py): every program
+built here must be content-identical across processes.
+"""
+
+import json
+import os
+import sys
+
+# A serving fleet host is a single-device process. Scrub the parent
+# test session's virtual-8-device XLA flag (tests/conftest.py) BEFORE
+# backend init: the multi-device CPU path is the environment's known
+# glibc-heap-corruption territory (ROADMAP watch item) and has no
+# business in this worker.
+os.environ["XLA_FLAGS"] = " ".join(
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if not f.startswith("--xla_force_host_platform_device_count"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import (  # noqa: E402
+    compile_cache,
+    fleet_serving,
+    flags,
+)
+from paddle_tpu.models import transformer as T  # noqa: E402
+
+
+def main():
+    cache_dir = sys.argv[1]
+    flags.set_flags({"telemetry": True, "compile_cache_dir": cache_dir})
+
+    cfg = T.TransformerConfig(
+        src_vocab_size=37, trg_vocab_size=41, max_length=64, d_model=16,
+        d_inner=32, n_head=2, n_layer=1, dropout=0.0,
+        label_smooth_eps=0.0)
+    scope = fluid.Scope()
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        T.build(cfg, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+
+    fleet = fleet_serving.ServingFleet(
+        cfg, scope, replicas=1, slots=2, src_len=8, max_len=10,
+        poll_s=0.005)
+    r1 = fleet.submit([5, 6, 7])
+    r2 = fleet.submit([9, 4])
+    cold_tokens = [r1.result(timeout=120), r2.result(timeout=120)]
+
+    # the autoscaler's spin-up path: the new replica must resolve its
+    # prefill + decode executables from the cache the first replica
+    # populated — zero NEW disk-tier misses
+    misses0 = compile_cache.stats()["misses"]
+    fleet._spawn_replica()
+    r3 = fleet.submit([5, 6, 7])
+    r4 = fleet.submit([9, 4])
+    scaled_tokens = [r3.result(timeout=120), r4.result(timeout=120)]
+    spinup_misses = compile_cache.stats()["misses"] - misses0
+    replica_count = fleet.stats()["replica_count"]
+    fleet.close()
+
+    print(json.dumps({
+        "stats": compile_cache.stats(),
+        "spinup_misses": spinup_misses,
+        "replica_count": replica_count,
+        "tokens": [[int(t) for t in s] for s in cold_tokens],
+        "scaled_tokens": [[int(t) for t in s] for s in scaled_tokens],
+    }))
+
+
+if __name__ == "__main__":
+    main()
